@@ -1,0 +1,73 @@
+"""mpi_tpu — a TPU-native message-passing framework.
+
+A from-scratch rebuild of the capabilities of ``btracey/mpi`` (an MPI-like
+point-to-point library over TCP, /root/reference) designed TPU-first:
+
+  * the reference's full API surface — ``init``/``finalize``/``rank``/
+    ``size``, blocking tagged rendezvous ``send``/``receive``, a pluggable
+    backend ``Interface`` with ``register``, the ``Raw`` passthrough type,
+    ``-mpi-*`` flag config, and local/SLURM launchers;
+  * a faithful TCP driver (:mod:`mpi_tpu.backends.tcp`) as CPU fallback and
+    bitwise-parity oracle;
+  * an XLA driver (:mod:`mpi_tpu.backends.xla`) that maps ranks onto a
+    ``jax.sharding.Mesh`` axis and lowers communication to XLA collectives
+    over ICI/DCN;
+  * **new** collectives — ``reduce``/``bcast``/``allgather``/``allreduce``/
+    ``gather``/``scatter``/``alltoall``/``barrier`` (the reference stubs
+    ``AllReduce`` out, mpi.go:130);
+  * a functional layer (:mod:`mpi_tpu.parallel`) for use *inside* ``jit``
+    ted SPMD code, plus Pallas ring/DMA kernels (:mod:`mpi_tpu.ops`).
+"""
+
+from .api import (
+    Interface,
+    MpiError,
+    NotInitializedError,
+    Raw,
+    TagError,
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    bcast,
+    finalize,
+    gather,
+    init,
+    rank,
+    receive,
+    reduce,
+    register,
+    registered,
+    scatter,
+    send,
+    sendrecv,
+    size,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Interface",
+    "MpiError",
+    "NotInitializedError",
+    "Raw",
+    "TagError",
+    "allgather",
+    "allreduce",
+    "alltoall",
+    "barrier",
+    "bcast",
+    "finalize",
+    "gather",
+    "init",
+    "rank",
+    "receive",
+    "reduce",
+    "register",
+    "registered",
+    "scatter",
+    "send",
+    "sendrecv",
+    "size",
+    "__version__",
+]

@@ -1,0 +1,309 @@
+"""Public MPI-like API facade and pluggable backend SPI.
+
+tpu-native rebuild of the reference's L2 layer (/root/reference/mpi.go):
+
+  * package-level operations delegating to one registered backend —
+    ``init``/``finalize``/``rank``/``size``/``send``/``receive``
+    (mpi.go:93-159);
+  * a backend SPI (``Interface``, mpi.go:163-170) with a process-global
+    registry (``register``, mpi.go:61-67 — second registration is an error);
+  * the ``Raw`` passthrough payload type (mpi.go:75-91, re-exported from
+    :mod:`mpi_tpu.utils.serialize`);
+  * the duplicate-tag misuse error (``TagError``; the reference declares
+    ``TagExists`` at mpi.go:174-182 but never constructs it — its runtime
+    panics instead, network.go:469,481,493. Here the declared error type is
+    actually raised.)
+
+Semantics preserved from the reference's package doc (mpi.go:20-48):
+all calls **block**; ``send`` does not return until the destination has
+accepted the message (rendezvous); concurrent sends must use distinct
+``{dest, tag}`` pairs and concurrent receives distinct ``{source, tag}``
+pairs (mpi.go:122-125, 153-156) — pairs may be reused once the earlier call
+returns. Callers use threads for asynchrony, as the reference uses
+goroutines.
+
+**New capability beyond the reference** (the north star): collectives.
+``reduce``/``bcast``/``allgather``/``allreduce``/``barrier``/``scatter``/
+``gather``/``alltoall`` — the reference stubs ``AllReduce`` out entirely
+(mpi.go:130, 69-71). Backends may implement them natively (the XLA driver
+lowers them to ``jax.lax`` collectives over ICI); otherwise the facade falls
+back to generic tree/ring algorithms built on ``send``/``receive``
+(:mod:`mpi_tpu.collectives_generic`), so every backend gets the full API.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Protocol, runtime_checkable
+
+from .utils.serialize import Raw
+
+__all__ = [
+    "Interface",
+    "register",
+    "registered",
+    "init",
+    "finalize",
+    "rank",
+    "size",
+    "send",
+    "receive",
+    "sendrecv",
+    "reduce",
+    "allreduce",
+    "bcast",
+    "allgather",
+    "gather",
+    "scatter",
+    "alltoall",
+    "barrier",
+    "Raw",
+    "MpiError",
+    "TagError",
+    "NotInitializedError",
+]
+
+
+class MpiError(RuntimeError):
+    """Base class for all framework errors."""
+
+
+class TagError(MpiError):
+    """A live ``{peer, tag}`` pair was used by a second concurrent call.
+
+    Realizes the reference's declared-but-dead ``TagExists`` error
+    (mpi.go:174-182); the reference's runtime instead panics inside
+    ``tagManager`` (network.go:469)."""
+
+    def __init__(self, tag: int, peer: int, direction: str = "send"):
+        self.tag = tag
+        self.peer = peer
+        self.direction = direction
+        super().__init__(
+            f"mpi_tpu: tag {tag} already live for concurrent {direction} "
+            f"with peer {peer}; {{peer, tag}} pairs must be unique among "
+            f"in-flight operations"
+        )
+
+
+class NotInitializedError(MpiError):
+    """An operation was called before ``init()`` / after ``finalize()``."""
+
+
+@runtime_checkable
+class Interface(Protocol):
+    """Backend SPI — the rebuild of ``mpi.Interface`` (mpi.go:163-170).
+
+    The six required operations match the reference one-for-one. The
+    collective methods are optional: the facade probes for them and falls
+    back to the generic send/receive implementations when absent.
+    """
+
+    def init(self) -> None: ...
+    def finalize(self) -> None: ...
+    def rank(self) -> int: ...
+    def size(self) -> int: ...
+    def send(self, data: Any, dest: int, tag: int) -> None: ...
+    def receive(self, source: int, tag: int, out: Optional[Any] = None) -> Any: ...
+
+
+_lock = threading.Lock()
+_backend: Optional[Interface] = None
+_registered_explicitly = False
+_initialized = False
+
+
+def _default_backend() -> Interface:
+    # The reference wires &Network{} as the default at package init
+    # (mpi.go:56). Importing the TCP driver lazily keeps `import mpi_tpu`
+    # free of socket/jax side effects.
+    from .backends.tcp import TcpNetwork
+
+    return TcpNetwork()
+
+
+def register(impl: Interface) -> None:
+    """Swap in a backend. Mirrors ``mpi.Register`` (mpi.go:61-67): may be
+    called at most once, and only before ``init``."""
+    global _backend, _registered_explicitly
+    with _lock:
+        if _registered_explicitly:
+            raise MpiError("mpi_tpu: register called twice (mpi.go:63-65 contract)")
+        if _initialized:
+            raise MpiError("mpi_tpu: register called after init")
+        _backend = impl
+        _registered_explicitly = True
+
+
+def registered() -> Interface:
+    """Return the active backend, creating the default on first use."""
+    global _backend
+    with _lock:
+        if _backend is None:
+            _backend = _default_backend()
+        return _backend
+
+
+def _reset_for_testing() -> None:
+    """Clear global registry state (no reference analogue; test hook)."""
+    global _backend, _registered_explicitly, _initialized
+    with _lock:
+        _backend = None
+        _registered_explicitly = False
+        _initialized = False
+
+
+def _require_init() -> Interface:
+    if not _initialized:
+        raise NotInitializedError("mpi_tpu: call init() first (mpi.go:26-30)")
+    return registered()
+
+
+def init() -> None:
+    """Initialize the communication network (mpi.go:96-98). Blocks until
+    every rank has connected (network.go:53-65)."""
+    global _initialized
+    impl = registered()
+    impl.init()
+    with _lock:
+        _initialized = True
+
+
+def finalize() -> None:
+    """Tear down the network (mpi.go:102-104)."""
+    global _initialized
+    impl = registered()
+    impl.finalize()
+    with _lock:
+        _initialized = False
+
+
+def rank() -> int:
+    """This process's rank in [0, size) (mpi.go:112-114)."""
+    return _require_init().rank()
+
+
+def size() -> int:
+    """Total number of ranks (mpi.go:117-119)."""
+    return _require_init().size()
+
+
+def send(data: Any, dest: int, tag: int) -> None:
+    """Blocking rendezvous send (mpi.go:126-128): returns only once rank
+    ``dest`` has accepted the message (network.go:569,617-624)."""
+    impl = _require_init()
+    _check_peer(dest, impl)
+    impl.send(data, dest, tag)
+
+
+def receive(source: int, tag: int, out: Optional[Any] = None) -> Any:
+    """Blocking receive (mpi.go:157-159). Returns the decoded payload.
+
+    ``out`` optionally supplies a preallocated buffer/ndarray to decode
+    into, mirroring the reference's receive-into-pointer + ``Raw`` buffer
+    reuse semantics (mpi.go:84-90)."""
+    impl = _require_init()
+    _check_peer(source, impl)
+    return impl.receive(source, tag, out=out)
+
+
+def exchange(impl: Interface, data: Any, dest: int, source: int, tag: int,
+             out: Optional[Any] = None,
+             recv_tag: Optional[int] = None) -> Any:
+    """Concurrent send+receive against ``impl`` — the shared engine for
+    :func:`sendrecv` and the generic collectives' pairwise rounds.
+    Deadlock-free where a sequential send-then-receive would
+    rendezvous-deadlock. ``recv_tag`` defaults to ``tag``."""
+    rtag = tag if recv_tag is None else recv_tag
+    result: List[Any] = [None]
+    err: List[Optional[BaseException]] = [None]
+
+    def _recv() -> None:
+        try:
+            result[0] = impl.receive(source, rtag, out=out)
+        except BaseException as exc:  # noqa: BLE001 - propagated below
+            err[0] = exc
+
+    t = threading.Thread(target=_recv, name="mpi-sendrecv", daemon=True)
+    t.start()
+    impl.send(data, dest, tag)
+    t.join()
+    if err[0] is not None:
+        raise err[0]
+    return result[0]
+
+
+def sendrecv(data: Any, dest: int, source: int, tag: int,
+             out: Optional[Any] = None) -> Any:
+    """Concurrent send+receive, the idiom every reference example spells
+    with goroutines (helloworld.go:53-81, bounce.go:86-137). Provided as a
+    convenience so Python callers don't need a thread for the common
+    exchange pattern."""
+    impl = _require_init()
+    _check_peer(dest, impl)
+    _check_peer(source, impl)
+    return exchange(impl, data, dest, source, tag, out=out)
+
+
+def _check_peer(peer: int, impl: Interface) -> None:
+    n = impl.size()
+    if not 0 <= peer < n:
+        raise MpiError(f"mpi_tpu: peer rank {peer} out of range [0, {n})")
+
+
+# ---------------------------------------------------------------------------
+# Collectives — new capability (reference stub: mpi.go:130, 69-71).
+# Native backend methods win; otherwise generic algorithms over send/receive.
+# ---------------------------------------------------------------------------
+
+def _collective(name: str, *args: Any, **kwargs: Any) -> Any:
+    impl = _require_init()
+    native = getattr(impl, name, None)
+    if native is not None:
+        return native(*args, **kwargs)
+    from . import collectives_generic as gen
+
+    return getattr(gen, name)(impl, *args, **kwargs)
+
+
+def allreduce(data: Any, op: str = "sum") -> Any:
+    """Combine ``data`` across all ranks with ``op`` and return the result
+    on every rank. ops: sum, prod, min, max. The north-star collective
+    (BASELINE.json north_star)."""
+    return _collective("allreduce", data, op=op)
+
+
+def reduce(data: Any, root: int = 0, op: str = "sum") -> Optional[Any]:
+    """Combine across ranks; result only on ``root`` (None elsewhere)."""
+    return _collective("reduce", data, root=root, op=op)
+
+
+def bcast(data: Any, root: int = 0) -> Any:
+    """Broadcast ``root``'s payload to every rank."""
+    return _collective("bcast", data, root=root)
+
+
+def allgather(data: Any) -> List[Any]:
+    """Gather every rank's payload to every rank, ordered by rank."""
+    return _collective("allgather", data)
+
+
+def gather(data: Any, root: int = 0) -> Optional[List[Any]]:
+    """Gather payloads to ``root`` (list ordered by rank; None elsewhere)."""
+    return _collective("gather", data, root=root)
+
+
+def scatter(data: Optional[List[Any]], root: int = 0) -> Any:
+    """Scatter ``root``'s list of per-rank payloads; returns this rank's."""
+    return _collective("scatter", data, root=root)
+
+
+def alltoall(data: List[Any]) -> List[Any]:
+    """Personalized all-to-all: element j of this rank's list goes to rank
+    j; returns the list of payloads received, ordered by source rank."""
+    return _collective("alltoall", data)
+
+
+def barrier() -> None:
+    """Block until every rank has entered the barrier."""
+    return _collective("barrier")

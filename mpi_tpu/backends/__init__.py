@@ -1,0 +1,9 @@
+"""Backend drivers implementing the :class:`mpi_tpu.api.Interface` SPI.
+
+``tcp`` — faithful rebuild of the reference's all-to-all TCP ``Network``
+(network.go); the CPU fallback and bitwise-parity oracle.
+
+``xla`` — the TPU-native driver: ranks are device-mesh positions and
+communication lowers to XLA collectives over ICI/DCN (imported lazily —
+importing this package must not import jax).
+"""

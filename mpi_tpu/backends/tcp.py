@@ -1,0 +1,558 @@
+"""TCP all-to-all driver — CPU fallback and bitwise-parity oracle.
+
+Rebuild of the reference's ``Network`` backend (/root/reference/network.go),
+preserving its observable semantics:
+
+  * leaderless deterministic rank assignment: sort the address list, rank =
+    index of own address; duplicate or missing addresses are errors
+    (network.go:94-118);
+  * eager all-to-all bootstrap at init: every pair of ranks holds two TCP
+    connections, one dialed by each side; ``dial`` carries my sends and the
+    peer's acks, ``listen`` carries the peer's sends and my acks
+    (network.go:122-159, 499-506);
+  * password-validated handshake with accept timeout on the listen side and
+    a 100 ms dial-retry loop until the init timeout on the dial side
+    (network.go:198-263, 294-351);
+  * tag-demultiplexed **rendezvous** messaging: ``send`` blocks until the
+    matching ``receive`` has accepted the payload, signalled by an ack
+    frame written back on the same connection the data arrived on
+    (network.go:518-625);
+  * in-process self-send rendezvous with first-arrival-creates semantics
+    (network.go:371-446);
+  * config resolution: explicit constructor args win over ``-mpi-*`` flags,
+    with a single-node ``":5000"`` default (network.go:55-58, 69-90).
+
+Deliberate fixes of the reference's latent defects (SURVEY.md §2), none of
+which change the documented contracts:
+
+  * self-send releases its tag on completion (the reference leaks it —
+    ``Send`` registers the tag at network.go:534 but the local path returns
+    without ``Delete`` at network.go:546-547, so tag reuse panics);
+  * one write lock per socket — the reference lets concurrent sends to the
+    same destination interleave gob streams on one conn (network.go:562);
+  * persistent per-connection reader threads replace per-call reader
+    goroutines, removing the reference's race where a reader spawned by
+    ``Receive(tagB)`` decodes a message for not-yet-registered ``tagA`` and
+    panics (network.go:587, 614);
+  * early-arriving messages for unregistered tags are buffered; rendezvous
+    is unaffected because the ack is only written when a ``receive``
+    actually dequeues.
+
+Wire protocol (replaces gob; all integers little-endian)::
+
+    frame      := kind:u8  tag:i64  length:u32  payload[length]
+    kind       := 0 DATA   payload = mpi_tpu.utils.serialize codec bytes
+                  1 ACK    payload = empty (length 0)
+                  2 HELLO  payload = utf-8 password; tag field carries the
+                           sender's claimed rank id (initialMessage
+                           {Password, Id}, network.go:198-201)
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import flags as flagmod
+from ..api import MpiError, TagError
+from ..utils.serialize import decode as codec_decode
+from ..utils.serialize import encode as codec_encode
+
+__all__ = ["TcpNetwork"]
+
+KIND_DATA = 0
+KIND_ACK = 1
+KIND_HELLO = 2
+
+_FRAME_HDR = struct.Struct("<BqI")
+_DIAL_RETRY_INTERVAL = 0.1  # network.go:298 — 100 ms poll
+
+
+class InitError(MpiError):
+    """Bootstrap failure; aggregates per-peer handshake errors
+    (network.go:185-195, 281-291)."""
+
+
+def _split_hostport(addr: str) -> Tuple[str, int]:
+    host, sep, port = addr.rpartition(":")
+    if not sep:
+        raise MpiError(f"mpi_tpu: address {addr!r} missing :port")
+    return host, int(port)
+
+
+def _send_frame(sock: socket.socket, lock: threading.Lock, kind: int,
+                tag: int, payload: bytes = b"") -> None:
+    header = _FRAME_HDR.pack(kind, tag, len(payload))
+    with lock:
+        sock.sendall(header + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("connection closed by peer")
+        got += r
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[int, int, bytes]:
+    kind, tag, length = _FRAME_HDR.unpack(_recv_exact(sock, _FRAME_HDR.size))
+    payload = _recv_exact(sock, length) if length else b""
+    return kind, tag, payload
+
+
+class _TagManager:
+    """Per-direction, per-peer tag → rendezvous-slot map.
+
+    Rebuild of ``tagManager`` (network.go:449-497) with the same misuse
+    detection (duplicate live tag → error instead of panic), plus buffering
+    of early arrivals (see module doc)."""
+
+    def __init__(self, direction: str, peer: int):
+        self._direction = direction
+        self._peer = peer
+        self._lock = threading.Lock()
+        self._slots: Dict[int, queue.Queue] = {}
+        self._claimed: set = set()
+        self._dead: Optional[BaseException] = None
+
+    def claim(self, tag: int) -> queue.Queue:
+        """Register a live caller-side use of ``tag`` (send or receive)."""
+        with self._lock:
+            if self._dead is not None:
+                raise self._dead
+            if tag in self._claimed:
+                raise TagError(tag, self._peer, self._direction)
+            self._claimed.add(tag)
+            return self._slots.setdefault(tag, queue.Queue())
+
+    def release(self, tag: int) -> None:
+        with self._lock:
+            self._claimed.discard(tag)
+            q = self._slots.get(tag)
+            if q is not None and q.empty():
+                del self._slots[tag]
+
+    def route(self, tag: int, item: Any) -> None:
+        """Deliver an inbound frame to the tag's slot (creating it if the
+        matching call hasn't arrived yet)."""
+        with self._lock:
+            q = self._slots.setdefault(tag, queue.Queue())
+        q.put(item)
+
+
+class _LocalRendezvous:
+    """In-process self-send path (network.go:371-446).
+
+    First arrival (sender or receiver) creates the per-tag entry and
+    records which side created it; a second arrival from the *same* side
+    while the entry is live is the misuse the reference panics on
+    (network.go:417,435) — here it raises :class:`TagError`. The entry is
+    removed once the handoff completes."""
+
+    _SENDER, _RECEIVER = "send(self)", "receive(self)"
+
+    def __init__(self, myrank: int):
+        self._rank = myrank
+        self._lock = threading.Lock()
+        self._entries: Dict[int, Tuple[str, queue.Queue, threading.Event]] = {}
+
+    def _entry(self, tag: int, side: str) -> Tuple[queue.Queue, threading.Event]:
+        with self._lock:
+            ent = self._entries.get(tag)
+            if ent is None:
+                q: queue.Queue = queue.Queue(maxsize=1)
+                done = threading.Event()
+                self._entries[tag] = (side, q, done)
+                return q, done
+            creator, q, done = ent
+            if creator == side:
+                raise TagError(tag, self._rank, side)
+            return q, done
+
+    def send(self, tag: int, payload: bytes) -> None:
+        q, done = self._entry(tag, self._SENDER)
+        q.put(payload)
+        done.wait()  # rendezvous: return only after receiver took it
+
+    def receive(self, tag: int) -> bytes:
+        q, done = self._entry(tag, self._RECEIVER)
+        payload = q.get()
+        # The receiver retires the entry *before* signalling the sender:
+        # popping under the lock here (rather than in send() after
+        # done.wait(), as the reference's sender-side delete does,
+        # network.go:427-429) closes a race where a second legal use of the
+        # same tag could observe the drained entry and deadlock.
+        with self._lock:
+            self._entries.pop(tag, None)
+        done.set()
+        return payload
+
+
+class _Peer:
+    """Connection pair to one peer (``pairwiseConnection``, network.go:499-506)."""
+
+    def __init__(self, peer_rank: int):
+        self.rank = peer_rank
+        self.dial_sock: Optional[socket.socket] = None   # my sends + their acks
+        self.listen_sock: Optional[socket.socket] = None  # their sends + my acks
+        self.dial_lock = threading.Lock()
+        self.listen_lock = threading.Lock()
+        self.sendtags = _TagManager("send", peer_rank)
+        self.receivetags = _TagManager("receive", peer_rank)
+        self.reader_threads: List[threading.Thread] = []
+
+
+class TcpNetwork:
+    """The default backend, as ``&Network{}`` is in the reference (mpi.go:56).
+
+    Constructor args mirror the user-settable ``Network`` fields
+    (network.go:25-39): ``proto``, ``addr``, ``addrs``, ``timeout``
+    (seconds), ``password``. Unset values resolve from the ``-mpi-*``
+    flags / ``MPI_TPU_*`` env at :meth:`init` (network.go:69-90)."""
+
+    def __init__(self, proto: Optional[str] = None, addr: Optional[str] = None,
+                 addrs: Optional[List[str]] = None,
+                 timeout: Optional[float] = None,
+                 password: Optional[str] = None):
+        self.proto = proto
+        self.addr = addr
+        self.addrs = list(addrs) if addrs else []
+        self.timeout = timeout
+        self.password = password
+
+        self._rank: Optional[int] = None
+        self._size: Optional[int] = None
+        self._peers: Dict[int, _Peer] = {}
+        self._local: Optional[_LocalRendezvous] = None
+        self._listener: Optional[socket.socket] = None
+        self._closed = threading.Event()
+        self._initialized = False
+
+    # -- Interface ----------------------------------------------------------
+
+    def rank(self) -> int:
+        if self._rank is None:
+            raise MpiError("mpi_tpu: rank() before init()")
+        return self._rank
+
+    def size(self) -> int:
+        if self._size is None:
+            raise MpiError("mpi_tpu: size() before init()")
+        return self._size
+
+    def init(self) -> None:
+        """Resolve config, assign ranks, build the all-to-all mesh
+        (network.go:53-65)."""
+        if self._initialized:
+            raise MpiError("mpi_tpu: init() called twice")
+        self._use_flags()
+        if not self.addrs:
+            # Single-node default (network.go:55-58).
+            self.addr = self.addr or ":5000"
+            self.addrs = [self.addr]
+        self._assign_ranks()
+        self._local = _LocalRendezvous(self._rank)
+        self._start_connections()
+        self._initialized = True
+
+    def finalize(self) -> None:
+        """Close every connection (network.go:354-369)."""
+        self._closed.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for peer in self._peers.values():
+            for sock in (peer.dial_sock, peer.listen_sock):
+                if sock is not None:
+                    try:
+                        sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+        for peer in self._peers.values():
+            for t in peer.reader_threads:
+                t.join(timeout=2.0)
+        self._initialized = False
+
+    def send(self, data: Any, dest: int, tag: int) -> None:
+        """Rendezvous send (network.go:518-572): encode, frame, block on ack."""
+        self._check_rank(dest)
+        payload = codec_encode(data)
+        if dest == self._rank:
+            # Self path: no tag manager involvement needed beyond the local
+            # rendezvous's own misuse detection — and unlike the reference
+            # we do not leak the tag (defect (a), SURVEY.md §2).
+            self._local.send(tag, payload)
+            return
+        peer = self._peers[dest]
+        ackq = peer.sendtags.claim(tag)
+        try:
+            _send_frame(peer.dial_sock, peer.dial_lock, KIND_DATA, tag, payload)
+            ack = ackq.get()  # blocks until receiver's ack (network.go:569)
+            if isinstance(ack, BaseException):
+                raise ack
+        finally:
+            peer.sendtags.release(tag)
+
+    def receive(self, source: int, tag: int, out: Optional[Any] = None) -> Any:
+        """Blocking receive (network.go:575-602): dequeue payload, ack, decode."""
+        self._check_rank(source)
+        if source == self._rank:
+            payload = self._local.receive(tag)
+            return codec_decode(payload, out=out)
+        peer = self._peers[source]
+        slot = peer.receivetags.claim(tag)
+        try:
+            payload = slot.get()
+            if isinstance(payload, BaseException):
+                raise payload
+            # Ack on the listen conn — this is what unblocks the sender's
+            # rendezvous (network.go:617-624); written only now, when the
+            # receive has genuinely accepted the data.
+            _send_frame(peer.listen_sock, peer.listen_lock, KIND_ACK, tag)
+        finally:
+            peer.receivetags.release(tag)
+        return codec_decode(payload, out=out)
+
+    # -- bootstrap ----------------------------------------------------------
+
+    def _use_flags(self) -> None:
+        """Explicit fields win over flags/env (network.go:69-90)."""
+        fl = flagmod.get_flags()
+        if self.proto is None:
+            self.proto = fl.protocol or flagmod.DEFAULT_PROTOCOL
+        if self.addr is None and fl.addr:
+            self.addr = fl.addr
+        if not self.addrs and fl.alladdr:
+            self.addrs = list(fl.alladdr)
+        if self.timeout is None:
+            self.timeout = (fl.inittimeout if fl.inittimeout is not None
+                            else flagmod.DEFAULT_INIT_TIMEOUT)
+        if self.password is None:
+            self.password = fl.password or ""
+
+    def _assign_ranks(self) -> None:
+        """Sorted-address consensus (network.go:94-118)."""
+        if self.addr is None:
+            if len(self.addrs) == 1:
+                self.addr = self.addrs[0]
+            else:
+                raise InitError("mpi_tpu: own address unset with multiple addrs")
+        ordered = sorted(self.addrs)
+        for a, b in zip(ordered, ordered[1:]):
+            if a == b:
+                raise InitError(f"mpi_tpu: duplicate address {a!r} in addrs")
+        try:
+            self._rank = ordered.index(self.addr)
+        except ValueError:
+            raise InitError(
+                f"mpi_tpu: own address {self.addr!r} not in addrs {ordered}") from None
+        self._size = len(ordered)
+        self.addrs = ordered
+
+    def _start_connections(self) -> None:
+        """Concurrent listen-side + dial-side all-to-all handshakes
+        (network.go:122-159)."""
+        n = self._size
+        me = self._rank
+        for r in range(n):
+            if r != me:
+                self._peers[r] = _Peer(r)
+        if n == 1:
+            return
+
+        errors: List[str] = []
+        err_lock = threading.Lock()
+
+        def note(err: str) -> None:
+            with err_lock:
+                errors.append(err)
+
+        # Listen side: accept n-1 peers, each validated by handshake.
+        host, port = _split_hostport(self.addr)
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            listener.bind((host, port))
+        except OSError as exc:
+            raise InitError(f"mpi_tpu: cannot listen on {self.addr!r}: {exc}") from exc
+        listener.listen(n)
+        listener.settimeout(self.timeout)  # accept timeout (network.go:223-234)
+        self._listener = listener
+
+        accepted = threading.Semaphore(0)
+
+        def listen_side() -> None:
+            pending = n - 1
+            while pending > 0:
+                try:
+                    conn, _ = listener.accept()
+                except (socket.timeout, OSError) as exc:
+                    note(f"rank {me}: accept failed/timed out: {exc}")
+                    for _ in range(pending):
+                        accepted.release()
+                    return
+                threading.Thread(target=listen_handshake, args=(conn,),
+                                 daemon=True).start()
+                pending -= 1
+
+        def listen_handshake(conn: socket.socket) -> None:
+            """network.go:211-263: read peer hello, validate, reply."""
+            try:
+                conn.settimeout(self.timeout)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                kind, claimed_id, payload = _recv_frame(conn)
+                if kind != KIND_HELLO:
+                    raise InitError(f"expected HELLO, got frame kind {kind}")
+                if payload.decode("utf-8") != self.password:
+                    raise InitError("password mismatch")  # network.go:344-347
+                if not 0 <= claimed_id < n or claimed_id == me:
+                    raise InitError(f"bad peer id {claimed_id}")  # network.go:348-350
+                lock = threading.Lock()
+                _send_frame(conn, lock, KIND_HELLO, me,
+                            self.password.encode("utf-8"))
+                conn.settimeout(None)
+                peer = self._peers[claimed_id]
+                peer.listen_sock = conn
+                peer.listen_lock = lock
+            except Exception as exc:  # noqa: BLE001 - aggregated, init fails
+                note(f"rank {me}: listen handshake failed: {exc}")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            finally:
+                accepted.release()
+
+        def dial_handshake(peer_rank: int) -> None:
+            """network.go:297-339: retry-dial peer, send hello, validate reply."""
+            target_host, target_port = _split_hostport(self.addrs[peer_rank])
+            deadline = time.monotonic() + self.timeout
+            sock: Optional[socket.socket] = None
+            while True:
+                try:
+                    sock = socket.create_connection(
+                        (target_host or "localhost", target_port),
+                        timeout=self.timeout)
+                    break
+                except OSError as exc:
+                    if time.monotonic() >= deadline:
+                        note(f"rank {me}: dial {self.addrs[peer_rank]!r} "
+                             f"timed out: {exc}")
+                        return
+                    time.sleep(_DIAL_RETRY_INTERVAL)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                lock = threading.Lock()
+                _send_frame(sock, lock, KIND_HELLO, me,
+                            self.password.encode("utf-8"))
+                sock.settimeout(self.timeout)
+                kind, their_id, payload = _recv_frame(sock)
+                if kind != KIND_HELLO:
+                    raise InitError(f"expected HELLO reply, got kind {kind}")
+                if payload.decode("utf-8") != self.password:
+                    raise InitError("password mismatch in reply")
+                if their_id != peer_rank:
+                    raise InitError(
+                        f"dialed rank {peer_rank} but peer claims {their_id}")
+                sock.settimeout(None)
+                peer = self._peers[peer_rank]
+                peer.dial_sock = sock
+                peer.dial_lock = lock
+            except Exception as exc:  # noqa: BLE001
+                note(f"rank {me}: dial handshake with rank {peer_rank} "
+                     f"failed: {exc}")
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        lt = threading.Thread(target=listen_side, daemon=True)
+        lt.start()
+        dial_threads = [threading.Thread(target=dial_handshake, args=(r,),
+                                         daemon=True)
+                        for r in range(n) if r != me]
+        for t in dial_threads:
+            t.start()
+        for t in dial_threads:
+            t.join()
+        lt.join()
+        for _ in range(n - 1):
+            accepted.acquire()
+
+        if not errors:
+            for peer in self._peers.values():
+                if peer.dial_sock is None:
+                    errors.append(f"rank {me}: no dial conn to {peer.rank}")
+                if peer.listen_sock is None:
+                    errors.append(f"rank {me}: no listen conn from {peer.rank}")
+        if errors:
+            self.finalize()
+            raise InitError("; ".join(sorted(set(errors))))
+
+        # Persistent readers (replace per-call goroutines; see module doc).
+        for peer in self._peers.values():
+            t1 = threading.Thread(target=self._dial_reader, args=(peer,),
+                                  name=f"mpi-ackreader-{peer.rank}", daemon=True)
+            t2 = threading.Thread(target=self._listen_reader, args=(peer,),
+                                  name=f"mpi-datareader-{peer.rank}", daemon=True)
+            peer.reader_threads = [t1, t2]
+            t1.start()
+            t2.start()
+
+    # -- data path ----------------------------------------------------------
+
+    def _dial_reader(self, peer: _Peer) -> None:
+        """Reads the peer's acks off my dial conn → unblocks my sends
+        (the ack-reader goroutine of network.go:551-559)."""
+        try:
+            while not self._closed.is_set():
+                kind, tag, _ = _recv_frame(peer.dial_sock)
+                if kind != KIND_ACK:
+                    raise MpiError(f"unexpected frame kind {kind} on dial conn")
+                peer.sendtags.route(tag, True)
+        except (ConnectionError, OSError, MpiError) as exc:
+            self._poison(peer.sendtags, exc)
+
+    def _listen_reader(self, peer: _Peer) -> None:
+        """Reads the peer's data frames off my listen conn → routes by tag
+        (``receiveReader``, network.go:607-625; ack deferred to receive())."""
+        try:
+            while not self._closed.is_set():
+                kind, tag, payload = _recv_frame(peer.listen_sock)
+                if kind != KIND_DATA:
+                    raise MpiError(f"unexpected frame kind {kind} on listen conn")
+                peer.receivetags.route(tag, payload)
+        except (ConnectionError, OSError, MpiError) as exc:
+            self._poison(peer.receivetags, exc)
+
+    def _poison(self, tags: _TagManager, exc: BaseException) -> None:
+        """On connection loss, fail all pending *and future* ops on this
+        direction instead of hanging (replaces the reference's reader
+        panics, network.go:555,611): ops already blocked get the exception
+        via their slot; ops issued after the loss fail at claim()."""
+        if self._closed.is_set():
+            exc = MpiError("mpi_tpu: network finalized")
+        with tags._lock:
+            tags._dead = exc
+            slots = list(tags._slots.values())
+        for q in slots:
+            q.put(exc)
+
+    def _check_rank(self, r: int) -> None:
+        if not 0 <= r < self._size:
+            raise MpiError(f"mpi_tpu: peer rank {r} out of range [0, {self._size})")

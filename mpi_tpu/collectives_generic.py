@@ -1,0 +1,281 @@
+"""Generic collectives built on the backend's blocking ``send``/``receive``.
+
+The reference has **no** collectives — ``AllReduce`` is a commented-out stub
+(mpi.go:130) with an unused ``isAllReducer`` capability probe (mpi.go:69-71).
+This module supplies the missing layer for *any* backend that only speaks
+point-to-point (notably the TCP driver, the CPU parity oracle). The XLA
+driver overrides these with native ``jax.lax`` collectives over ICI; these
+implementations define the **canonical deterministic reduction order** that
+the XLA driver's ``deterministic=True`` path reproduces, which is what makes
+"bitwise-identical results to the TCP backend" (BASELINE.json north_star)
+achievable for floating-point reductions.
+
+Canonical reduction order (used by ``reduce``/``allreduce`` here and by
+``parallel.collectives.tree_allreduce``): binomial-tree recursive halving.
+In round ``k`` (distance ``d = 2**k``), every rank ``r`` with
+``r % (2d) == 0`` and ``r + d < n`` combines ``acc[r] = op(acc[r],
+acc[r+d])`` — lower-rank partial always on the left. This is well defined
+for any ``n`` and fixes the float summation tree exactly.
+
+Requirements inherited from MPI semantics: all ranks must invoke the same
+collectives in the same order (tags for collective traffic are drawn from a
+reserved tag space ``>= COLL_TAG_BASE`` using a per-backend sequence number,
+so collective traffic can never collide with user point-to-point tags).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from .api import Interface, MpiError
+from .api import exchange as _sendrecv  # shared concurrent-exchange engine
+
+__all__ = [
+    "COLL_TAG_BASE",
+    "combine",
+    "reduce",
+    "allreduce",
+    "bcast",
+    "gather",
+    "scatter",
+    "allgather",
+    "alltoall",
+    "barrier",
+]
+
+# User tags live below this; collective rounds allocate from above it.
+COLL_TAG_BASE = 1 << 48
+_TAGS_PER_COLLECTIVE = 4096
+
+
+def _next_tag_base(impl: Interface) -> int:
+    """Per-backend monotone sequence → disjoint tag block per collective.
+
+    Correct because collectives must be invoked in the same order on every
+    rank (standard MPI requirement, documented in module doc)."""
+    lock = getattr(impl, "_coll_lock", None)
+    if lock is None:
+        lock = threading.Lock()
+        setattr(impl, "_coll_lock", lock)
+    with lock:
+        seq = getattr(impl, "_coll_seq", 0)
+        setattr(impl, "_coll_seq", seq + 1)
+    return COLL_TAG_BASE + seq * _TAGS_PER_COLLECTIVE
+
+
+_OPS: dict[str, Callable[[np.ndarray, np.ndarray], np.ndarray]] = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+def check_op(op: str) -> None:
+    """Validate a reduction op name. Called on *every* rank before any
+    communication so a bad op fails everywhere instead of deadlocking the
+    ranks whose partner errored."""
+    if op not in _OPS:
+        raise MpiError(f"mpi_tpu: unknown reduction op {op!r}; "
+                       f"expected one of {sorted(_OPS)}")
+
+
+def combine(a: Any, b: Any, op: str) -> Any:
+    """``op(a, b)`` elementwise, preserving dtype. Shared by every backend
+    so the arithmetic (not just the order) is identical across drivers."""
+    check_op(op)
+    fn = _OPS[op]
+    an, bn = np.asarray(a), np.asarray(b)
+    if an.shape != bn.shape:
+        raise MpiError(
+            f"mpi_tpu: reduction shape mismatch across ranks: {an.shape} vs {bn.shape}")
+    out = fn(an, bn)
+    if np.isscalar(a) or an.ndim == 0:
+        return out[()] if isinstance(out, np.ndarray) else out
+    return out
+
+
+
+
+def reduce(impl: Interface, data: Any, root: int = 0, op: str = "sum",
+           _tag_base: Optional[int] = None) -> Optional[Any]:
+    """Binomial-tree reduce in the canonical order; result on ``root``.
+
+    The tree is rooted at rank 0; a final point-to-point hop moves the
+    result to ``root`` when ``root != 0`` so the combination order is
+    *independent of root* (simplifies bitwise-parity guarantees)."""
+    check_op(op)
+    tag = _next_tag_base(impl) if _tag_base is None else _tag_base
+    me, n = impl.rank(), impl.size()
+    acc = np.asarray(data)
+    d = 1
+    rnd = 0
+    while d < n:
+        if me % (2 * d) == 0:
+            if me + d < n:
+                other = impl.receive(me + d, tag + rnd)
+                acc = combine(acc, other, op)
+        elif me % (2 * d) == d:
+            impl.send(acc, me - d, tag + rnd)
+            acc = None  # handed off
+        d *= 2
+        rnd += 1
+    if root != 0:
+        if me == 0:
+            impl.send(acc, root, tag + rnd)
+            acc = None
+        elif me == root:
+            acc = impl.receive(0, tag + rnd)
+    return acc if me == root else None
+
+
+def bcast(impl: Interface, data: Any, root: int = 0,
+          _tag_base: Optional[int] = None) -> Any:
+    """Binomial-tree broadcast (inverse shape of ``reduce``'s tree)."""
+    tag = _next_tag_base(impl) if _tag_base is None else _tag_base
+    me, n = impl.rank(), impl.size()
+    rel = (me - root) % n  # relabel so the tree is rooted at `root`
+    # Highest power of two <= n-1 determines the first round distance.
+    d = 1
+    while d < n:
+        d *= 2
+    d //= 2
+    rnd = 0
+    payload = data if me == root else None
+    have = me == root
+    while d >= 1:
+        if rel % (2 * d) == 0 and have:
+            if rel + d < n:
+                impl.send(payload, (root + rel + d) % n, tag + rnd)
+        elif rel % (2 * d) == d and not have:
+            payload = impl.receive((root + rel - d) % n, tag + rnd)
+            have = True
+        d //= 2
+        rnd += 1
+    return payload
+
+
+def allreduce(impl: Interface, data: Any, op: str = "sum") -> Any:
+    """reduce-to-0 + bcast, preserving the canonical combination order.
+
+    A ring reduce-scatter+allgather would move less data for large buffers,
+    but would change the float combination order; the canonical tree is the
+    bitwise contract. (The XLA driver's fast path is free to use ``psum``
+    when determinism isn't requested.)"""
+    tag = _next_tag_base(impl)
+    result = reduce(impl, data, root=0, op=op, _tag_base=tag)
+    return bcast(impl, result, root=0, _tag_base=tag + 64)
+
+
+def gather(impl: Interface, data: Any, root: int = 0) -> Optional[List[Any]]:
+    """Direct gather: each rank sends to root; root returns rank-ordered list."""
+    tag = _next_tag_base(impl)
+    me, n = impl.rank(), impl.size()
+    if me == root:
+        out: List[Any] = [None] * n
+        out[me] = data
+        # Receives run concurrently so sender blocking order can't deadlock
+        # (each non-root send rendezvouses with its own receive).
+        threads = []
+        errs: List[Optional[BaseException]] = [None] * n
+        for src in range(n):
+            if src == root:
+                continue
+
+            def _recv(src: int = src) -> None:
+                try:
+                    out[src] = impl.receive(src, tag + src)
+                except BaseException as exc:  # noqa: BLE001
+                    errs[src] = exc
+
+            t = threading.Thread(target=_recv, name=f"mpi-gather-{src}", daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        for e in errs:
+            if e is not None:
+                raise e
+        return out
+    impl.send(data, root, tag + me)
+    return None
+
+
+def scatter(impl: Interface, data: Optional[List[Any]], root: int = 0) -> Any:
+    """Root distributes ``data[i]`` to rank ``i``; returns this rank's item."""
+    tag = _next_tag_base(impl)
+    me, n = impl.rank(), impl.size()
+    if me == root:
+        if data is None or len(data) != n:
+            raise MpiError(
+                f"mpi_tpu: scatter root needs a list of exactly {n} payloads")
+        threads = []
+        errs: List[Optional[BaseException]] = [None] * n
+        for dst in range(n):
+            if dst == root:
+                continue
+
+            def _send(dst: int = dst) -> None:
+                try:
+                    impl.send(data[dst], dst, tag + dst)
+                except BaseException as exc:  # noqa: BLE001
+                    errs[dst] = exc
+
+            t = threading.Thread(target=_send, name=f"mpi-scatter-{dst}", daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        for e in errs:
+            if e is not None:
+                raise e
+        return data[root]
+    return impl.receive(root, tag + me)
+
+
+def allgather(impl: Interface, data: Any) -> List[Any]:
+    """Ring allgather: n-1 rotations; each rank forwards the chunk it
+    received last round. Rank-ordered result everywhere."""
+    tag = _next_tag_base(impl)
+    me, n = impl.rank(), impl.size()
+    out: List[Any] = [None] * n
+    out[me] = data
+    right, left = (me + 1) % n, (me - 1) % n
+    current = data
+    for step in range(n - 1):
+        current = _sendrecv(impl, current, right, left, tag + step)
+        out[(me - step - 1) % n] = current
+    return out
+
+
+def alltoall(impl: Interface, data: List[Any]) -> List[Any]:
+    """Personalized all-to-all via n-1 rotation rounds of pairwise
+    exchanges (deadlock-free: send/receive run concurrently per round)."""
+    me, n = impl.rank(), impl.size()
+    if len(data) != n:
+        raise MpiError(f"mpi_tpu: alltoall needs exactly {n} payloads, got {len(data)}")
+    tag = _next_tag_base(impl)
+    out: List[Any] = [None] * n
+    out[me] = data[me]
+    for offset in range(1, n):
+        dst = (me + offset) % n
+        src = (me - offset) % n
+        out[src] = _sendrecv(impl, data[dst], dst, src, tag + offset)
+    return out
+
+
+def barrier(impl: Interface) -> None:
+    """Dissemination barrier: ceil(log2 n) rounds of token exchanges."""
+    tag = _next_tag_base(impl)
+    me, n = impl.rank(), impl.size()
+    d = 1
+    rnd = 0
+    while d < n:
+        dst = (me + d) % n
+        src = (me - d) % n
+        _sendrecv(impl, b"", dst, src, tag + rnd)
+        d *= 2
+        rnd += 1

@@ -1,0 +1,218 @@
+"""Flag/environment configuration system.
+
+The reference registers five command-line flags at import time
+(/root/reference/flags.go:44-50) which double as the launcher<->program ABI:
+the launchers (/root/reference/mpirun/gompirun/gompirun.go:77,
+/root/reference/mpirun/gompirunslurm/slurm.go:103) synthesize ``-mpi-addr``
+and ``-mpi-alladdr`` flags for every spawned rank, and ``Network.useFlags``
+(/root/reference/network.go:69-90) resolves unset struct fields from them.
+
+This module keeps the exact same flag names (so launcher-injected argv is
+wire-compatible with the reference's UX) and layers an environment-variable
+fallback (``MPI_TPU_*``) on top, which is the idiomatic transport for cluster
+launchers (SLURM, GKE, TPU pods) that prefer env to argv.
+
+Resolution precedence, mirroring network.go:69-90:
+  explicitly-set backend attribute  >  CLI flag  >  environment  >  default.
+
+Unlike Go's ``flag`` package, parsing here is *tolerant*: unknown argv
+entries are ignored so user programs keep their own CLI space without
+coordinating with us (the reference instead requires the program to call
+``flag.Parse()`` itself, mpi.go:43).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+__all__ = [
+    "MpiFlags",
+    "parse_duration",
+    "format_duration",
+    "parse_flags",
+    "get_flags",
+    "set_argv_for_testing",
+    "FLAG_ADDR",
+    "FLAG_ALLADDR",
+    "FLAG_INITTIMEOUT",
+    "FLAG_PROTOCOL",
+    "FLAG_PASSWORD",
+    "DEFAULT_PROTOCOL",
+    "DEFAULT_INIT_TIMEOUT",
+]
+
+# Flag names — identical spelling to flags.go:44-50 so launcher-injected
+# argv runs unmodified. Both single- and double-dash forms are accepted.
+FLAG_ADDR = "mpi-addr"
+FLAG_ALLADDR = "mpi-alladdr"
+FLAG_INITTIMEOUT = "mpi-inittimeout"
+FLAG_PROTOCOL = "mpi-protocol"
+FLAG_PASSWORD = "mpi-password"
+
+ENV_PREFIX = "MPI_TPU_"
+ENV_ADDR = ENV_PREFIX + "ADDR"
+ENV_ALLADDR = ENV_PREFIX + "ALLADDR"
+ENV_INITTIMEOUT = ENV_PREFIX + "INITTIMEOUT"
+ENV_PROTOCOL = ENV_PREFIX + "PROTOCOL"
+ENV_PASSWORD = ENV_PREFIX + "PASSWORD"
+
+DEFAULT_PROTOCOL = "tcp"  # flags.go:48 default
+# The reference's DurationFlag has no default (zero value); Network.Init then
+# treats zero as "no timeout" for the listen side but the dial side polls
+# until Timeout elapses (network.go:297-312). A finite default is safer.
+DEFAULT_INIT_TIMEOUT = 60.0  # seconds
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DURATION_UNITS = {
+    "ns": 1e-9,
+    "us": 1e-6,
+    "µs": 1e-6,
+    "ms": 1e-3,
+    "s": 1.0,
+    "m": 60.0,
+    "h": 3600.0,
+}
+
+
+def parse_duration(text: str) -> float:
+    """Parse a Go-style duration string ("300ms", "1m30s", "10s") to seconds.
+
+    Mirrors the reference's ``DurationFlag`` (flags.go:29-42), which wraps
+    Go's ``time.ParseDuration``. Bare numbers are treated as seconds.
+    """
+    text = text.strip()
+    if not text:
+        raise ValueError("empty duration")
+    try:
+        return float(text)  # bare number → seconds
+    except ValueError:
+        pass
+    pos = 0
+    total = 0.0
+    for m in _DURATION_RE.finditer(text):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration {text!r}")
+        total += float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(text):
+        raise ValueError(f"invalid duration {text!r}")
+    return total
+
+
+def format_duration(seconds: float) -> str:
+    """Inverse of :func:`parse_duration`, used when re-injecting flags.
+
+    Falls back to the bare-seconds form for awkward values so the
+    round-trip is always exact (a "0.0004" stays 400 µs instead of
+    truncating to "0ms")."""
+    if seconds >= 1 and float(seconds).is_integer():
+        return f"{int(seconds)}s"
+    return repr(float(seconds))
+
+
+@dataclass
+class MpiFlags:
+    """Resolved values of the five ``-mpi-*`` flags (flags.go:10-14)."""
+
+    addr: Optional[str] = None
+    alladdr: List[str] = field(default_factory=list)
+    inittimeout: Optional[float] = None  # seconds
+    protocol: Optional[str] = None
+    password: Optional[str] = None
+
+    def as_argv(self) -> List[str]:
+        """Render back to launcher-injectable argv (gompirun.go:77 ABI)."""
+        out: List[str] = []
+        if self.addr is not None:
+            out += [f"--{FLAG_ADDR}", self.addr]
+        if self.alladdr:
+            out += [f"--{FLAG_ALLADDR}", ",".join(self.alladdr)]
+        if self.inittimeout is not None:
+            out += [f"--{FLAG_INITTIMEOUT}", format_duration(self.inittimeout)]
+        if self.protocol is not None:
+            out += [f"--{FLAG_PROTOCOL}", self.protocol]
+        if self.password is not None:
+            out += [f"--{FLAG_PASSWORD}", self.password]
+        return out
+
+
+_FLAG_NAMES = {FLAG_ADDR, FLAG_ALLADDR, FLAG_INITTIMEOUT, FLAG_PROTOCOL, FLAG_PASSWORD}
+
+# Overridable argv source for tests (instead of mutating sys.argv).
+_argv_override: Optional[Sequence[str]] = None
+
+
+def set_argv_for_testing(argv: Optional[Sequence[str]]) -> None:
+    global _argv_override
+    _argv_override = argv
+
+
+def _scan_argv(argv: Sequence[str]) -> Dict[str, str]:
+    """Extract ``-mpi-*`` flags from argv, ignoring everything else.
+
+    Accepts ``-name value``, ``--name value``, ``-name=value``,
+    ``--name=value``.
+    """
+    found: Dict[str, str] = {}
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if tok.startswith("-"):
+            body = tok.lstrip("-")
+            if "=" in body:
+                name, _, value = body.partition("=")
+                if name in _FLAG_NAMES:
+                    found[name] = value
+            elif body in _FLAG_NAMES:
+                if i + 1 < len(argv):
+                    found[body] = argv[i + 1]
+                    i += 1
+        i += 1
+    return found
+
+
+def parse_flags(argv: Optional[Sequence[str]] = None,
+                environ: Optional[Dict[str, str]] = None) -> MpiFlags:
+    """Resolve the five flags from argv then environment.
+
+    argv wins over env for each individual flag, matching the reference's
+    "flags are the source of truth the launcher controls" design.
+    """
+    if argv is None:
+        argv = _argv_override if _argv_override is not None else sys.argv[1:]
+    env = os.environ if environ is None else environ
+
+    raw = _scan_argv(argv)
+    flags = MpiFlags()
+
+    addr = raw.get(FLAG_ADDR, env.get(ENV_ADDR))
+    if addr:
+        flags.addr = addr
+
+    alladdr = raw.get(FLAG_ALLADDR, env.get(ENV_ALLADDR))
+    if alladdr:
+        # Comma-separated list, as AddrsFlag (flags.go:16-27).
+        flags.alladdr = [a for a in (s.strip() for s in alladdr.split(",")) if a]
+
+    timeout = raw.get(FLAG_INITTIMEOUT, env.get(ENV_INITTIMEOUT))
+    if timeout:
+        flags.inittimeout = parse_duration(timeout)
+
+    proto = raw.get(FLAG_PROTOCOL, env.get(ENV_PROTOCOL))
+    if proto:
+        flags.protocol = proto
+
+    password = raw.get(FLAG_PASSWORD, env.get(ENV_PASSWORD))
+    if password is not None:
+        flags.password = password
+
+    return flags
+
+
+def get_flags() -> MpiFlags:
+    """Parse flags from the live process argv/env (used by backend init)."""
+    return parse_flags()

@@ -1,0 +1,5 @@
+"""Utility layer: wire codec, structured logging."""
+
+from .serialize import CodecError, Raw, decode, encode
+
+__all__ = ["CodecError", "Raw", "decode", "encode"]

@@ -1,0 +1,139 @@
+"""API facade + backend SPI tests (reference: mpi.go)."""
+
+import threading
+
+import pytest
+
+import mpi_tpu
+from mpi_tpu import api
+
+
+class FakeBackend:
+    """In-process fake — the test seam the reference's Interface SPI
+    admits but never uses (SURVEY.md §4)."""
+
+    def __init__(self, rank=0, size=1):
+        self._rank, self._size = rank, size
+        self.inited = False
+        self.sent = []
+        self.inbox = {}
+
+    def init(self):
+        self.inited = True
+
+    def finalize(self):
+        self.inited = False
+
+    def rank(self):
+        return self._rank
+
+    def size(self):
+        return self._size
+
+    def send(self, data, dest, tag):
+        self.sent.append((data, dest, tag))
+
+    def receive(self, source, tag, out=None):
+        return self.inbox.get((source, tag))
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    api._reset_for_testing()
+    yield
+    api._reset_for_testing()
+
+
+class TestRegistry:
+    def test_register_twice_errors(self):
+        mpi_tpu.register(FakeBackend())
+        with pytest.raises(mpi_tpu.MpiError, match="register called twice"):
+            mpi_tpu.register(FakeBackend())
+
+    def test_register_after_init_errors(self):
+        mpi_tpu.register(FakeBackend())
+        mpi_tpu.init()
+        with pytest.raises(mpi_tpu.MpiError):
+            mpi_tpu.register(FakeBackend())
+
+    def test_default_backend_is_tcp(self):
+        # mpi.go:56 wires &Network{} as the default.
+        from mpi_tpu.backends.tcp import TcpNetwork
+
+        assert isinstance(mpi_tpu.registered(), TcpNetwork)
+
+    def test_registered_returns_registered_impl(self):
+        fake = FakeBackend()
+        mpi_tpu.register(fake)
+        assert mpi_tpu.registered() is fake
+
+    def test_fake_satisfies_interface_protocol(self):
+        assert isinstance(FakeBackend(), mpi_tpu.Interface)
+
+
+class TestLifecycle:
+    def test_ops_before_init_raise(self):
+        mpi_tpu.register(FakeBackend())
+        for op in [mpi_tpu.rank, mpi_tpu.size]:
+            with pytest.raises(mpi_tpu.NotInitializedError):
+                op()
+        with pytest.raises(mpi_tpu.NotInitializedError):
+            mpi_tpu.send(b"x", 0, 1)
+        with pytest.raises(mpi_tpu.NotInitializedError):
+            mpi_tpu.receive(0, 1)
+
+    def test_init_finalize_cycle(self):
+        fake = FakeBackend()
+        mpi_tpu.register(fake)
+        mpi_tpu.init()
+        assert fake.inited
+        assert mpi_tpu.rank() == 0
+        assert mpi_tpu.size() == 1
+        mpi_tpu.finalize()
+        assert not fake.inited
+        with pytest.raises(mpi_tpu.NotInitializedError):
+            mpi_tpu.rank()
+
+    def test_send_receive_delegate(self):
+        fake = FakeBackend(rank=0, size=3)
+        fake.inbox[(2, 7)] = b"payload"
+        mpi_tpu.register(fake)
+        mpi_tpu.init()
+        mpi_tpu.send(b"out", 1, 5)
+        assert fake.sent == [(b"out", 1, 5)]
+        assert mpi_tpu.receive(2, 7) == b"payload"
+
+    def test_peer_range_checked(self):
+        mpi_tpu.register(FakeBackend(rank=0, size=2))
+        mpi_tpu.init()
+        with pytest.raises(mpi_tpu.MpiError, match="out of range"):
+            mpi_tpu.send(b"x", 2, 0)
+        with pytest.raises(mpi_tpu.MpiError, match="out of range"):
+            mpi_tpu.receive(-1, 0)
+
+
+class TestSendrecv:
+    def test_concurrent_exchange(self):
+        class Echo(FakeBackend):
+            def __init__(self):
+                super().__init__(rank=0, size=2)
+                self.ev = threading.Event()
+
+            def send(self, data, dest, tag):
+                self.ev.wait(5)  # would deadlock a sequential send→recv
+
+            def receive(self, source, tag, out=None):
+                self.ev.set()
+                return b"reply"
+
+        mpi_tpu.register(Echo())
+        mpi_tpu.init()
+        assert mpi_tpu.sendrecv(b"ping", dest=1, source=1, tag=3) == b"reply"
+
+
+class TestTagError:
+    def test_fields_and_message(self):
+        err = mpi_tpu.TagError(42, 3, "receive")
+        assert err.tag == 42 and err.peer == 3
+        assert "42" in str(err) and "unique" in str(err)
+        assert isinstance(err, mpi_tpu.MpiError)

@@ -1,0 +1,178 @@
+"""Generic (send/receive-based) collectives over the TCP driver.
+
+These are NEW capability vs the reference (AllReduce is a stub, mpi.go:130);
+the deterministic tree order defined here is the bitwise contract the XLA
+driver's deterministic path must match (see test_bitwise.py)."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu import collectives_generic as gen
+
+from conftest import run_on_ranks, tcp_cluster
+
+
+@pytest.fixture(params=[2, 3, 4, 5], ids=lambda n: f"n{n}")
+def anycluster(request):
+    with tcp_cluster(request.param) as nets:
+        yield nets
+
+
+class TestAllreduce:
+    def test_sum_scalars(self, anycluster):
+        n = len(anycluster)
+        out = run_on_ranks(anycluster,
+                           lambda net, r: gen.allreduce(net, float(r + 1)))
+        expect = sum(range(1, n + 1))
+        assert all(float(o) == expect for o in out)
+
+    @pytest.mark.parametrize("op,reducer", [
+        ("sum", np.add.reduce), ("prod", np.multiply.reduce),
+        ("min", np.minimum.reduce), ("max", np.maximum.reduce)])
+    def test_ops_arrays(self, anycluster, op, reducer):
+        n = len(anycluster)
+        rng = np.random.default_rng(7)
+        contribs = [rng.standard_normal((4, 8)) for _ in range(n)]
+        expect = reducer(np.stack(contribs))
+        out = run_on_ranks(anycluster,
+                           lambda net, r: gen.allreduce(net, contribs[r], op=op))
+        for o in out:
+            np.testing.assert_allclose(o, expect, rtol=1e-12)
+
+    def test_deterministic_tree_order(self, anycluster):
+        # Bitwise reproducibility: the canonical tree must give the exact
+        # same float32 bits as explicitly replaying the tree order.
+        n = len(anycluster)
+        rng = np.random.default_rng(3)
+        contribs = [rng.standard_normal(257).astype(np.float32)
+                    for _ in range(n)]
+
+        def tree_expect():
+            acc = {r: contribs[r].copy() for r in range(n)}
+            d = 1
+            while d < n:
+                for r in range(0, n, 2 * d):
+                    if r + d < n:
+                        acc[r] = acc[r] + acc[r + d]
+                d *= 2
+            return acc[0]
+
+        expect = tree_expect()
+        out = run_on_ranks(anycluster,
+                           lambda net, r: gen.allreduce(net, contribs[r]))
+        for o in out:
+            assert o.tobytes() == expect.tobytes()  # bitwise
+
+    def test_unknown_op(self, anycluster):
+        from mpi_tpu.api import MpiError
+
+        with pytest.raises(MpiError, match="unknown reduction op"):
+            run_on_ranks(anycluster,
+                         lambda net, r: gen.allreduce(net, 1.0, op="xor"))
+
+
+class TestReduceBcast:
+    @pytest.mark.parametrize("root", [0, 1])
+    def test_reduce_to_root(self, anycluster, root):
+        n = len(anycluster)
+        out = run_on_ranks(anycluster,
+                           lambda net, r: gen.reduce(net, r + 1, root=root))
+        for r, o in enumerate(out):
+            if r == root:
+                assert int(o) == n * (n + 1) // 2
+            else:
+                assert o is None
+
+    @pytest.mark.parametrize("root", [0, 1])
+    def test_bcast(self, anycluster, root):
+        payload = {"weights": np.arange(10.0), "step": 3}
+
+        def body(net, r):
+            data = payload if r == root else None
+            return gen.bcast(net, data, root=root)
+
+        out = run_on_ranks(anycluster, body)
+        for o in out:
+            assert o["step"] == 3
+            np.testing.assert_array_equal(o["weights"], payload["weights"])
+
+
+class TestGatherScatter:
+    def test_gather(self, anycluster):
+        n = len(anycluster)
+        out = run_on_ranks(anycluster,
+                           lambda net, r: gen.gather(net, f"from{r}", root=0))
+        assert out[0] == [f"from{r}" for r in range(n)]
+        assert all(o is None for o in out[1:])
+
+    def test_scatter(self, anycluster):
+        n = len(anycluster)
+        items = [np.full(3, r) for r in range(n)]
+
+        def body(net, r):
+            return gen.scatter(net, items if r == 0 else None, root=0)
+
+        out = run_on_ranks(anycluster, body)
+        for r, o in enumerate(out):
+            np.testing.assert_array_equal(o, items[r])
+
+    def test_scatter_wrong_length(self, anycluster):
+        from mpi_tpu.api import MpiError
+
+        def body(net, r):
+            data = [1] if r == 0 else None
+            if r == 0:
+                with pytest.raises(MpiError, match="exactly"):
+                    gen.scatter(net, data, root=0)
+
+        run_on_ranks(anycluster, body)
+
+
+class TestAllgatherAlltoall:
+    def test_allgather_ring(self, anycluster):
+        n = len(anycluster)
+        out = run_on_ranks(anycluster,
+                           lambda net, r: gen.allgather(net, r * 10))
+        for o in out:
+            assert [int(x) for x in o] == [r * 10 for r in range(n)]
+
+    def test_alltoall(self, anycluster):
+        n = len(anycluster)
+
+        def body(net, r):
+            return gen.alltoall(net, [f"{r}->{d}" for d in range(n)])
+
+        out = run_on_ranks(anycluster, body)
+        for r, o in enumerate(out):
+            assert o == [f"{s}->{r}" for s in range(n)]
+
+
+class TestBarrier:
+    def test_barrier_synchronizes(self, anycluster):
+        import time
+
+        t_after = [None] * len(anycluster)
+        t_before = [None] * len(anycluster)
+
+        def body(net, r):
+            time.sleep(0.1 * r)  # stagger arrivals
+            t_before[r] = time.monotonic()
+            gen.barrier(net)
+            t_after[r] = time.monotonic()
+
+        run_on_ranks(anycluster, body)
+        # No rank exits the barrier before the last rank entered it.
+        assert min(t_after) >= max(t_before) - 1e-3
+
+    def test_repeated_collectives(self, anycluster):
+        # Tag-space sequencing: many collectives back-to-back must not
+        # collide (reserved tag blocks per invocation).
+        def body(net, r):
+            total = 0.0
+            for i in range(10):
+                total += float(gen.allreduce(net, float(r + i)))
+                gen.barrier(net)
+            return total
+
+        out = run_on_ranks(anycluster, body)
+        assert len(set(out)) == 1

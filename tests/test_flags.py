@@ -1,0 +1,87 @@
+"""Flag/config system tests (reference: flags.go, network.go:69-90)."""
+
+import pytest
+
+from mpi_tpu import flags as F
+
+
+class TestParseDuration:
+    def test_go_style(self):
+        assert F.parse_duration("10s") == 10.0
+        assert F.parse_duration("300ms") == pytest.approx(0.3)
+        assert F.parse_duration("1m30s") == 90.0
+        assert F.parse_duration("2h") == 7200.0
+        assert F.parse_duration("1.5s") == 1.5
+        assert F.parse_duration("250us") == pytest.approx(250e-6)
+
+    def test_bare_number_is_seconds(self):
+        assert F.parse_duration("42") == 42.0
+        assert F.parse_duration("0.5") == 0.5
+
+    def test_invalid(self):
+        for bad in ["", "10x", "s10", "10s5", "ten seconds"]:
+            with pytest.raises(ValueError):
+                F.parse_duration(bad)
+
+    def test_format_roundtrip(self):
+        for secs in [1.0, 90.0, 0.3, 0.001]:
+            assert F.parse_duration(F.format_duration(secs)) == pytest.approx(secs)
+
+
+class TestParseFlags:
+    def test_all_five_flags_space_form(self):
+        fl = F.parse_flags([
+            "--mpi-addr", ":6000",
+            "--mpi-alladdr", ":6000,:6001,:6002",
+            "--mpi-inittimeout", "10s",
+            "--mpi-protocol", "tcp",
+            "--mpi-password", "hunter2",
+        ], environ={})
+        assert fl.addr == ":6000"
+        assert fl.alladdr == [":6000", ":6001", ":6002"]
+        assert fl.inittimeout == 10.0
+        assert fl.protocol == "tcp"
+        assert fl.password == "hunter2"
+
+    def test_single_dash_and_equals_forms(self):
+        # The reference's Go flag package accepts -mpi-addr=:6000; so do we.
+        fl = F.parse_flags(["-mpi-addr=:6000", "-mpi-alladdr", ":6000"],
+                           environ={})
+        assert fl.addr == ":6000"
+        assert fl.alladdr == [":6000"]
+
+    def test_unknown_flags_ignored(self):
+        fl = F.parse_flags(["--verbose", "-n", "3", "--mpi-addr", ":7000",
+                            "positional"], environ={})
+        assert fl.addr == ":7000"
+
+    def test_env_fallback(self):
+        fl = F.parse_flags([], environ={
+            F.ENV_ADDR: ":8000",
+            F.ENV_ALLADDR: ":8000, :8001",
+            F.ENV_INITTIMEOUT: "5s",
+            F.ENV_PROTOCOL: "tcp",
+            F.ENV_PASSWORD: "pw",
+        })
+        assert fl.addr == ":8000"
+        assert fl.alladdr == [":8000", ":8001"]  # whitespace trimmed
+        assert fl.inittimeout == 5.0
+        assert fl.password == "pw"
+
+    def test_argv_beats_env(self):
+        fl = F.parse_flags(["--mpi-addr", ":1"], environ={F.ENV_ADDR: ":2"})
+        assert fl.addr == ":1"
+
+    def test_empty_gives_defaults(self):
+        fl = F.parse_flags([], environ={})
+        assert fl.addr is None
+        assert fl.alladdr == []
+        assert fl.inittimeout is None
+        assert fl.protocol is None
+        assert fl.password is None
+
+    def test_as_argv_roundtrip(self):
+        fl = F.MpiFlags(addr=":6000", alladdr=[":6000", ":6001"],
+                        inittimeout=10.0, protocol="tcp", password="x")
+        again = F.parse_flags(fl.as_argv(), environ={})
+        assert again == fl

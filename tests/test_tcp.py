@@ -1,0 +1,326 @@
+"""TCP driver tests (reference: network.go).
+
+Runs N in-process ranks on localhost — the single-machine full-stack
+distributed harness (the reference's gompirun-on-loopback story,
+gompirun.go:46-51, compressed into one process)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpi_tpu.api import MpiError, TagError
+from mpi_tpu.backends.tcp import InitError, TcpNetwork
+
+from conftest import run_on_ranks, tcp_cluster
+
+
+class TestRankAssignment:
+    def test_sorted_addr_consensus(self):
+        # network.go:94-109: rank = index in sorted address list.
+        addrs = ["127.0.0.1:09002", "127.0.0.1:09000", "127.0.0.1:09001"]
+        net = TcpNetwork(addr="127.0.0.1:09001", addrs=addrs)
+        net._assign_ranks()
+        assert net.rank() == 1
+        assert net.size() == 3
+
+    def test_duplicate_addr_rejected(self):
+        net = TcpNetwork(addr=":1", addrs=[":1", ":1"])
+        with pytest.raises(InitError, match="duplicate"):
+            net._assign_ranks()
+
+    def test_own_addr_missing_rejected(self):
+        net = TcpNetwork(addr=":9", addrs=[":1", ":2"])
+        with pytest.raises(InitError, match="not in addrs"):
+            net._assign_ranks()
+
+    def test_single_node_default(self):
+        # network.go:55-58: no addrs → ":5000", rank 0 of 1.
+        net = TcpNetwork(timeout=1.0)
+        net.init()
+        try:
+            assert net.rank() == 0
+            assert net.size() == 1
+            assert net.addr == ":5000"
+        finally:
+            net.finalize()
+
+
+class TestClusterBootstrap:
+    def test_ranks_agree(self, cluster4):
+        assert [m.rank() for m in cluster4] == [0, 1, 2, 3]
+        assert all(m.size() == 4 for m in cluster4)
+
+    def test_password_mismatch_fails_init(self):
+        from conftest import _free_ports
+
+        ports = _free_ports(2)
+        addrs = sorted(f"127.0.0.1:{p:05d}" for p in ports)
+        a = TcpNetwork(addr=addrs[0], addrs=addrs, password="right", timeout=2.0)
+        b = TcpNetwork(addr=addrs[1], addrs=addrs, password="wrong", timeout=2.0)
+        errs = [None, None]
+
+        def _init(net, i):
+            try:
+                net.init()
+            except BaseException as exc:  # noqa: BLE001
+                errs[i] = exc
+
+        ts = [threading.Thread(target=_init, args=(n, i), daemon=True)
+              for i, n in enumerate((a, b))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=15)
+        assert any(isinstance(e, InitError) for e in errs)
+        for n in (a, b):
+            n.finalize()
+
+    def test_dial_timeout(self):
+        # Peer never comes up → init fails within the timeout
+        # (network.go:297-312 retry-until-deadline).
+        from conftest import _free_ports
+
+        ports = _free_ports(2)
+        addrs = sorted(f"127.0.0.1:{p:05d}" for p in ports)
+        net = TcpNetwork(addr=addrs[0], addrs=addrs, timeout=1.0)
+        t0 = time.monotonic()
+        with pytest.raises(InitError):
+            net.init()
+        assert time.monotonic() - t0 < 10
+
+
+class TestSendReceive:
+    def test_pairwise_bytes(self, cluster4):
+        def body(net, r):
+            if r == 0:
+                net.send(b"hello from 0", dest=1, tag=7)
+            elif r == 1:
+                assert net.receive(0, tag=7) == b"hello from 0"
+
+        run_on_ranks(cluster4, body)
+
+    def test_ndarray_roundtrip(self, cluster4):
+        payload = np.arange(1000, dtype=np.float64).reshape(10, 100)
+
+        def body(net, r):
+            if r == 2:
+                net.send(payload, dest=3, tag=1)
+            elif r == 3:
+                got = net.receive(2, tag=1)
+                np.testing.assert_array_equal(got, payload)
+
+        run_on_ranks(cluster4, body)
+
+    def test_all_to_all_concurrent(self, cluster4):
+        # The helloworld pattern (helloworld.go:53-81): every rank sends to
+        # and receives from every rank, including itself, concurrently.
+        n = len(cluster4)
+
+        def body(net, r):
+            errs = []
+
+            def _send(dst):
+                try:
+                    net.send(f"{r}->{dst}", dest=dst, tag=100 + r)
+                except BaseException as exc:  # noqa: BLE001
+                    errs.append(exc)
+
+            got = {}
+
+            def _recv(src):
+                try:
+                    got[src] = net.receive(src, tag=100 + src)
+                except BaseException as exc:  # noqa: BLE001
+                    errs.append(exc)
+
+            ts = [threading.Thread(target=_send, args=(d,), daemon=True)
+                  for d in range(n)]
+            ts += [threading.Thread(target=_recv, args=(s,), daemon=True)
+                   for s in range(n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=20)
+            assert not errs, errs
+            assert got == {s: f"{s}->{r}" for s in range(n)}
+
+        run_on_ranks(cluster4, body)
+
+    def test_rendezvous_send_blocks_until_receive(self, cluster4):
+        # network.go:569: Send returns only after the receiver accepted.
+        state = {"send_done_at": None, "recv_called_at": None}
+
+        def body(net, r):
+            if r == 0:
+                net.send(b"x", dest=1, tag=5)
+                state["send_done_at"] = time.monotonic()
+            elif r == 1:
+                time.sleep(0.5)
+                state["recv_called_at"] = time.monotonic()
+                net.receive(0, tag=5)
+
+        run_on_ranks(cluster4, body)
+        assert state["send_done_at"] >= state["recv_called_at"]
+
+    def test_tag_demux_out_of_order(self, cluster4):
+        # Two messages, receives issued in the opposite order of sends.
+        def body(net, r):
+            if r == 0:
+                net.send(b"first", dest=1, tag=1)
+                net.send(b"second", dest=1, tag=2)
+            elif r == 1:
+                time.sleep(0.3)  # let both arrive (early-arrival buffering)
+                assert net.receive(0, tag=2) == b"second"
+                assert net.receive(0, tag=1) == b"first"
+
+        # Sequential sends would rendezvous-block; use a thread for send #1.
+        def body_async(net, r):
+            if r == 0:
+                t = threading.Thread(
+                    target=net.send, args=(b"first", 1, 1), daemon=True)
+                t.start()
+                net.send(b"second", dest=1, tag=2)
+                t.join(timeout=10)
+            elif r == 1:
+                time.sleep(0.3)
+                assert net.receive(0, tag=2) == b"second"
+                assert net.receive(0, tag=1) == b"first"
+
+        run_on_ranks(cluster4, body_async)
+
+    def test_large_payload(self, cluster4):
+        big = np.random.default_rng(1).integers(0, 255, 10_000_000,
+                                                dtype=np.uint8)
+
+        def body(net, r):
+            if r == 0:
+                net.send(big.tobytes(), dest=1, tag=9)
+            elif r == 1:
+                got = net.receive(0, tag=9)
+                assert got == big.tobytes()
+
+        run_on_ranks(cluster4, body, timeout=60)
+
+    def test_receive_out_buffer(self, cluster4):
+        src_arr = np.arange(64, dtype=np.float32)
+
+        def body(net, r):
+            if r == 0:
+                net.send(src_arr, dest=1, tag=3)
+            elif r == 1:
+                buf = np.zeros(64, np.float32)
+                got = net.receive(0, tag=3, out=buf)
+                assert got is buf
+                np.testing.assert_array_equal(buf, src_arr)
+
+        run_on_ranks(cluster4, body)
+
+    def test_peer_out_of_range(self, cluster4):
+        with pytest.raises(MpiError, match="out of range"):
+            cluster4[0].send(b"x", dest=99, tag=0)
+
+    def test_tag_reuse_after_completion_ok(self, cluster4):
+        # mpi.go:123-125: the {dest, tag} pair may be reused once the
+        # earlier call returns.
+        def body(net, r):
+            for i in range(5):
+                if r == 0:
+                    net.send(f"msg{i}", dest=1, tag=42)
+                elif r == 1:
+                    assert net.receive(0, tag=42) == f"msg{i}"
+
+        run_on_ranks(cluster4, body)
+
+    def test_duplicate_concurrent_send_tag_raises(self, cluster4):
+        # Misuse detection: two live sends, same {dest, tag}
+        # (network.go:469 panic → TagError here).
+        def body(net, r):
+            if r == 0:
+                t = threading.Thread(target=net.send, args=(b"a", 1, 8),
+                                     daemon=True)
+                t.start()
+                time.sleep(0.2)  # first send is parked in rendezvous
+                with pytest.raises(TagError):
+                    net.send(b"b", dest=1, tag=8)
+                net.send(b"unblock", dest=1, tag=99)
+                t.join(timeout=10)
+            elif r == 1:
+                assert net.receive(0, tag=99) == b"unblock"
+                assert net.receive(0, tag=8) == b"a"
+
+        run_on_ranks(cluster4, body)
+
+
+class TestSelfSend:
+    def test_self_send_concurrent(self, cluster4):
+        def body(net, r):
+            t = threading.Thread(target=net.send, args=(f"self{r}", r, 11),
+                                 daemon=True)
+            t.start()
+            assert net.receive(r, tag=11) == f"self{r}"
+            t.join(timeout=10)
+
+        run_on_ranks(cluster4, body)
+
+    def test_self_send_receiver_first(self, cluster4):
+        # First-arrival-creates semantics (network.go:388-446): the
+        # receiver can park before the sender shows up.
+        def body(net, r):
+            if r != 0:
+                return
+            box = []
+            t = threading.Thread(target=lambda: box.append(net.receive(0, 13)),
+                                 daemon=True)
+            t.start()
+            time.sleep(0.2)
+            net.send(b"late", dest=0, tag=13)
+            t.join(timeout=10)
+            assert box == [b"late"]
+
+        run_on_ranks(cluster4, body)
+
+    def test_self_send_tag_not_leaked(self, cluster4):
+        # Regression for reference defect (a) (SURVEY.md §2): a second
+        # self-send with the same tag must work after the first completes.
+        def body(net, r):
+            if r != 1:
+                return
+            for i in range(3):
+                t = threading.Thread(target=net.send,
+                                     args=(f"pass{i}", 1, 77), daemon=True)
+                t.start()
+                assert net.receive(1, tag=77) == f"pass{i}"
+                t.join(timeout=10)
+
+        run_on_ranks(cluster4, body)
+
+    def test_double_concurrent_self_send_same_tag_raises(self, cluster4):
+        def body(net, r):
+            if r != 2:
+                return
+            t = threading.Thread(target=net.send, args=(b"a", 2, 5),
+                                 daemon=True)
+            t.start()
+            time.sleep(0.2)
+            with pytest.raises(TagError):
+                net.send(b"b", dest=2, tag=5)
+            assert net.receive(2, tag=5) == b"a"
+            t.join(timeout=10)
+
+        run_on_ranks(cluster4, body)
+
+
+class TestTwoRanks:
+    def test_minimal_pair(self):
+        with tcp_cluster(2) as nets:
+            def body(net, r):
+                if r == 0:
+                    net.send(b"ping", dest=1, tag=0)
+                    assert net.receive(1, tag=1) == b"pong"
+                else:
+                    assert net.receive(0, tag=0) == b"ping"
+                    net.send(b"pong", dest=0, tag=1)
+
+            run_on_ranks(nets, body)
