@@ -1,0 +1,100 @@
+"""bounce — ping-pong latency/bandwidth sweep (the reference's perf harness).
+
+Rebuild of /root/reference/examples/bounce/bounce.go: even/odd rank pairs
+exchange messages of sizes {0, 1, 10, ..., 10^7} bytes (bounce.go:33), 10
+repeats each (bounce.go:35), with both raw-bytes and float64-array payloads
+(the float64 leg measured gob's typed-encode overhead, bounce.go:114-136;
+here it measures the codec's zero-copy ndarray path). Each echo is
+integrity-checked (bounce.go:104-108, 131-136) and even ranks print the
+mean round-trip microseconds per size (bounce.go:149-152).
+
+Run::
+
+    python -m mpi_tpu.launch.mpirun 2 examples/bounce.py
+    python -m mpi_tpu.launch.mpirun 2 examples/bounce.py -- --json
+
+Requires an even number of ranks (bounce.go:54-58).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mpi_tpu
+
+SIZES = [0] + [10 ** k for k in range(8)]  # bounce.go:33
+REPS = 10  # bounce.go:35
+
+
+def sweep(rank: int, partner: int, payload_full, slicer, check, label: str,
+          results: dict) -> None:
+    even = rank % 2 == 0
+    for length in SIZES:
+        msg = slicer(payload_full, length)
+        times = []
+        for rep in range(REPS):
+            tag = rank if even else partner  # unique live {peer, tag} pair
+            if even:
+                t0 = time.perf_counter()
+                mpi_tpu.send(msg, partner, tag)
+                echo = mpi_tpu.receive(partner, tag)
+                times.append(time.perf_counter() - t0)
+                if not check(echo, msg):
+                    raise SystemExit(
+                        f"rank {rank}: {label} echo mismatch at size {length}")
+            else:
+                got = mpi_tpu.receive(partner, tag)
+                mpi_tpu.send(got, partner, tag)
+        if even:
+            results[(label, length)] = 1e6 * float(np.mean(times))
+
+
+def main() -> None:
+    emit_json = "--json" in sys.argv
+    mpi_tpu.init()
+    try:
+        rank, size = mpi_tpu.rank(), mpi_tpu.size()
+        if size % 2 != 0:
+            raise SystemExit("bounce requires an even number of ranks "
+                             "(bounce.go:54-58)")
+        partner = rank + 1 if rank % 2 == 0 else rank - 1
+
+        rng = np.random.default_rng(42)
+        byte_msg = rng.integers(0, 256, SIZES[-1], dtype=np.uint8).tobytes()
+        f64_msg = rng.standard_normal(SIZES[-1])  # bounce.go:70-77
+
+        results: dict = {}
+        sweep(rank, partner, byte_msg,
+              lambda m, L: m[:L],
+              lambda a, b: a == b, "bytes", results)
+        sweep(rank, partner, f64_msg,
+              lambda m, L: m[:L],
+              lambda a, b: np.array_equal(np.asarray(a), b), "float64", results)
+
+        if rank % 2 == 0:
+            if emit_json:
+                print(json.dumps({
+                    "rank": rank,
+                    "sizes": SIZES,
+                    "reps": REPS,
+                    "bytes_us": [results[("bytes", L)] for L in SIZES],
+                    "float64_us": [results[("float64", L)] for L in SIZES],
+                }), flush=True)
+            else:
+                print(f"rank {rank} <-> {partner}  mean round-trip per size "
+                      f"({REPS} reps)", flush=True)
+                print(f"{'size':>10}  {'bytes µs':>12}  {'float64[] µs':>12}")
+                for L in SIZES:
+                    print(f"{L:>10}  {results[('bytes', L)]:>12.1f}  "
+                          f"{results[('float64', L)]:>12.1f}", flush=True)
+    finally:
+        mpi_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

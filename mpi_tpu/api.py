@@ -226,7 +226,18 @@ def exchange(impl: Interface, data: Any, dest: int, source: int, tag: int,
 
     t = threading.Thread(target=_recv, name="mpi-sendrecv", daemon=True)
     t.start()
-    impl.send(data, dest, tag)
+    try:
+        impl.send(data, dest, tag)
+    except BaseException:
+        # Don't orphan the posted receive: it would hold its {source, tag}
+        # claim forever and could consume-and-ack a message meant for a
+        # later call. Backends may support cancellation; fall back to a
+        # bounded join otherwise.
+        cancel = getattr(impl, "cancel_receive", None)
+        if cancel is not None:
+            cancel(source, rtag)
+        t.join(timeout=30.0)
+        raise
     t.join()
     if err[0] is not None:
         raise err[0]

@@ -91,7 +91,10 @@ def _send_frame(sock: socket.socket, lock: threading.Lock, kind: int,
         sock.sendall(header + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly ``n`` bytes. Returns the freshly-owned bytearray
+    (no defensive copy — the caller is the sole owner, which lets
+    decode() alias large payloads zero-copy)."""
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
@@ -100,13 +103,28 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
         if r == 0:
             raise ConnectionError("connection closed by peer")
         got += r
-    return bytes(buf)
+    return buf
 
 
-def _recv_frame(sock: socket.socket) -> Tuple[int, int, bytes]:
+def _recv_frame(sock: socket.socket) -> Tuple[int, int, bytearray]:
     kind, tag, length = _FRAME_HDR.unpack(_recv_exact(sock, _FRAME_HDR.size))
-    payload = _recv_exact(sock, length) if length else b""
+    payload = _recv_exact(sock, length) if length else bytearray()
     return kind, tag, payload
+
+
+class ReceiveCancelled(MpiError):
+    """A pending receive was cancelled via ``cancel_receive`` (used by
+    :func:`mpi_tpu.api.exchange` to clean up after a failed send)."""
+
+
+class _Cancel:
+    """Cancellation token routed into a tag slot. Carries the claim
+    generation it targets so a token that loses a race with real data
+    cannot poison a *later* claim of the same tag."""
+
+    def __init__(self, gen: int, exc: BaseException):
+        self.gen = gen
+        self.exc = exc
 
 
 class _TagManager:
@@ -114,7 +132,7 @@ class _TagManager:
 
     Rebuild of ``tagManager`` (network.go:449-497) with the same misuse
     detection (duplicate live tag → error instead of panic), plus buffering
-    of early arrivals (see module doc)."""
+    of early arrivals (see module doc) and generation-tagged cancellation."""
 
     def __init__(self, direction: str, peer: int):
         self._direction = direction
@@ -122,17 +140,31 @@ class _TagManager:
         self._lock = threading.Lock()
         self._slots: Dict[int, queue.Queue] = {}
         self._claimed: set = set()
+        self._gen: Dict[int, int] = {}
         self._dead: Optional[BaseException] = None
 
-    def claim(self, tag: int) -> queue.Queue:
-        """Register a live caller-side use of ``tag`` (send or receive)."""
+    def claim(self, tag: int) -> Tuple[queue.Queue, int]:
+        """Register a live caller-side use of ``tag`` (send or receive).
+        Returns the slot and this claim's generation."""
         with self._lock:
             if self._dead is not None:
                 raise self._dead
             if tag in self._claimed:
                 raise TagError(tag, self._peer, self._direction)
             self._claimed.add(tag)
-            return self._slots.setdefault(tag, queue.Queue())
+            gen = self._gen.get(tag, 0) + 1
+            self._gen[tag] = gen
+            return self._slots.setdefault(tag, queue.Queue()), gen
+
+    def cancel(self, tag: int, exc: BaseException) -> bool:
+        """Best-effort cancel of the live claim on ``tag``."""
+        with self._lock:
+            if tag not in self._claimed:
+                return False
+            q = self._slots.setdefault(tag, queue.Queue())
+            gen = self._gen.get(tag, 0)
+        q.put(_Cancel(gen, exc))
+        return True
 
     def release(self, tag: int) -> None:
         with self._lock:
@@ -183,9 +215,28 @@ class _LocalRendezvous:
         q.put(payload)
         done.wait()  # rendezvous: return only after receiver took it
 
+    def cancel(self, tag: int, exc: BaseException) -> bool:
+        """Best-effort cancel of a parked self-receive: only succeeds while
+        no sender has engaged (entry created by the receiver, still empty)."""
+        with self._lock:
+            ent = self._entries.get(tag)
+            if ent is None:
+                return False
+            creator, q, _done = ent
+            if creator != self._RECEIVER or not q.empty():
+                return False
+            self._entries.pop(tag)
+        try:
+            q.put_nowait(_Cancel(0, exc))
+            return True
+        except queue.Full:
+            return False
+
     def receive(self, tag: int) -> bytes:
         q, done = self._entry(tag, self._RECEIVER)
         payload = q.get()
+        if isinstance(payload, _Cancel):
+            raise payload.exc
         # The receiver retires the entry *before* signalling the sender:
         # popping under the lock here (rather than in send() after
         # done.wait(), as the reference's sender-side delete does,
@@ -299,7 +350,7 @@ class TcpNetwork:
             self._local.send(tag, payload)
             return
         peer = self._peers[dest]
-        ackq = peer.sendtags.claim(tag)
+        ackq, _gen = peer.sendtags.claim(tag)
         try:
             _send_frame(peer.dial_sock, peer.dial_lock, KIND_DATA, tag, payload)
             ack = ackq.get()  # blocks until receiver's ack (network.go:569)
@@ -315,11 +366,17 @@ class TcpNetwork:
             payload = self._local.receive(tag)
             return codec_decode(payload, out=out)
         peer = self._peers[source]
-        slot = peer.receivetags.claim(tag)
+        slot, gen = peer.receivetags.claim(tag)
         try:
-            payload = slot.get()
-            if isinstance(payload, BaseException):
-                raise payload
+            while True:
+                payload = slot.get()
+                if isinstance(payload, _Cancel):
+                    if payload.gen == gen:
+                        raise payload.exc
+                    continue  # stale token from an earlier claim — drop
+                if isinstance(payload, BaseException):
+                    raise payload
+                break
             # Ack on the listen conn — this is what unblocks the sender's
             # rendezvous (network.go:617-624); written only now, when the
             # receive has genuinely accepted the data.
@@ -327,6 +384,18 @@ class TcpNetwork:
         finally:
             peer.receivetags.release(tag)
         return codec_decode(payload, out=out)
+
+    def cancel_receive(self, source: int, tag: int) -> bool:
+        """Best-effort cancellation of a pending receive (no reference
+        analogue; supports :func:`mpi_tpu.api.exchange` cleanup). Returns
+        False when the receive already completed or cannot be cancelled
+        (self-receives with a sender already engaged)."""
+        self._check_rank(source)
+        exc = ReceiveCancelled(
+            f"mpi_tpu: receive(source={source}, tag={tag}) cancelled")
+        if source == self._rank:
+            return self._local.cancel(tag, exc)
+        return self._peers[source].receivetags.cancel(tag, exc)
 
     # -- bootstrap ----------------------------------------------------------
 
@@ -554,5 +623,7 @@ class TcpNetwork:
             q.put(exc)
 
     def _check_rank(self, r: int) -> None:
+        if self._size is None:
+            raise MpiError("mpi_tpu: send/receive before init()")
         if not 0 <= r < self._size:
             raise MpiError(f"mpi_tpu: peer rank {r} out of range [0, {self._size})")

@@ -1,0 +1,11 @@
+"""Process launchers (the reference's L3, /root/reference/mpirun/).
+
+``mpirun`` — local launcher (gompirun parity): N processes on localhost
+ports, wired via the ``-mpi-*`` flag ABI.
+
+``slurm`` — SLURM launcher (gompirunslurm parity): one srun per node parsed
+from ``SLURM_JOB_NODELIST``, plus TPU-slice topology discovery.
+
+Launchers never import the backend — the contract is purely the flag
+protocol, as in the reference (SURVEY.md L3: launchers don't import mpi).
+"""

@@ -1,0 +1,128 @@
+"""Local process launcher — rebuild of ``gompirun``
+(/root/reference/mpirun/gompirun/gompirun.go).
+
+Usage::
+
+    python -m mpi_tpu.launch.mpirun [options] N prog [args...]
+
+Spawns N copies of ``prog`` on localhost, one rank per process, appending
+the ``--mpi-addr``/``--mpi-alladdr`` flags each rank needs to find the
+others (the flag-protocol ABI of gompirun.go:68-90). Ranks get consecutive
+ports starting at ``--port-base`` (default 6000, gompirun.go:46-51);
+child stdio is piped straight through (gompirun.go:86-88).
+
+Differences from the reference, all additive:
+
+  * ``.py`` programs are run under the current Python interpreter;
+  * ``--port-base``, ``--timeout`` and ``--password`` options (the
+    reference hardcodes 6000 and never injects the other flags);
+  * the exit code is the first non-zero child exit code, so CI can use it
+    (the reference only logs failures).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+from ..flags import FLAG_ADDR, FLAG_ALLADDR, FLAG_INITTIMEOUT, FLAG_PASSWORD, format_duration
+
+DEFAULT_PORT_BASE = 6000  # gompirun.go:46
+
+
+def build_commands(nprocs: int, prog: str, prog_args: Sequence[str],
+                   port_base: int = DEFAULT_PORT_BASE,
+                   timeout: Optional[float] = None,
+                   password: Optional[str] = None,
+                   host: str = "") -> List[List[str]]:
+    """Synthesize the per-rank command lines (the launcher<->program ABI).
+
+    Pure function so tests can check the protocol without spawning."""
+    addrs = [f"{host}:{port_base + i}" for i in range(nprocs)]
+    alladdr = ",".join(addrs)
+    cmds = []
+    for i in range(nprocs):
+        if prog.endswith(".py"):
+            cmd = [sys.executable, prog]
+        else:
+            cmd = [prog]
+        cmd += list(prog_args)
+        cmd += [f"--{FLAG_ADDR}", addrs[i], f"--{FLAG_ALLADDR}", alladdr]
+        if timeout is not None:
+            cmd += [f"--{FLAG_INITTIMEOUT}", format_duration(timeout)]
+        if password is not None:
+            cmd += [f"--{FLAG_PASSWORD}", password]
+        cmds.append(cmd)
+    return cmds
+
+
+def launch(nprocs: int, prog: str, prog_args: Sequence[str],
+           port_base: int = DEFAULT_PORT_BASE,
+           timeout: Optional[float] = None,
+           password: Optional[str] = None,
+           env: Optional[dict] = None) -> int:
+    """Spawn all ranks concurrently, wait for all (gompirun.go:57-93).
+
+    Returns the first non-zero child exit code, else 0."""
+    cmds = build_commands(nprocs, prog, prog_args, port_base=port_base,
+                          timeout=timeout, password=password)
+    procs: List[subprocess.Popen] = []
+    child_env = dict(os.environ if env is None else env)
+    for i, cmd in enumerate(cmds):
+        # stdio passthrough, as gompirun pipes child output (gompirun.go:86-88)
+        procs.append(subprocess.Popen(cmd, env=child_env))
+
+    # Poll until every rank exits — but once any rank fails, kill the
+    # survivors instead of letting them sit in dial-retry until the init
+    # timeout (a CI-friendliness improvement over the reference, which
+    # only logs failures, gompirun.go:90-92).
+    first_bad: Optional[int] = None
+    pending = set(range(nprocs))
+    while pending:
+        for i in sorted(pending):
+            code = procs[i].poll()
+            if code is None:
+                continue
+            pending.discard(i)
+            if code and first_bad is None:
+                first_bad = code
+                print(f"mpirun: rank {i} exited with code {code}; "
+                      f"terminating remaining ranks", file=sys.stderr)
+                for j in pending:
+                    procs[j].terminate()
+        if pending:
+            time.sleep(0.05)
+    return first_bad or 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="mpirun",
+        description="Launch N local ranks of an mpi_tpu program "
+                    "(gompirun parity).")
+    parser.add_argument("--port-base", type=int, default=DEFAULT_PORT_BASE,
+                        help="first rank's port (default 6000)")
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="init timeout in seconds injected as "
+                             "--mpi-inittimeout")
+    parser.add_argument("--password", default=None,
+                        help="shared secret injected as --mpi-password")
+    parser.add_argument("nprocs", type=int,
+                        help="number of ranks to launch")
+    parser.add_argument("prog", help="program to run (.py runs under python)")
+    parser.add_argument("prog_args", nargs=argparse.REMAINDER,
+                        help="arguments passed through to the program")
+    args = parser.parse_args(argv)
+    if args.nprocs < 1:
+        parser.error("N must be >= 1")
+    return launch(args.nprocs, args.prog, args.prog_args,
+                  port_base=args.port_base, timeout=args.timeout,
+                  password=args.password)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,12 @@ def encode(data: Any) -> bytes:
     if isinstance(data, (int, float, bool, complex, np.generic)):
         data = np.asarray(data)
     if isinstance(data, np.ndarray):
+        if data.dtype.hasobject or data.dtype.kind == "V":
+            # Object arrays hold pointers and structured/void arrays lose
+            # their field layout through the raw-buffer path — both must
+            # ride the pickle fallback.
+            return bytes([KIND_PICKLE]) + pickle.dumps(
+                data, protocol=pickle.HIGHEST_PROTOCOL)
         # NB: np.ascontiguousarray promotes 0-d to 1-d — avoid it for 0-d.
         arr = data if data.ndim == 0 or data.flags.c_contiguous \
             else np.ascontiguousarray(data)
@@ -147,7 +153,12 @@ def decode(payload: bytes, out: Optional[Any] = None) -> Any:
         ):
             out.view(np.uint8).reshape(-1)[:] = np.frombuffer(arr_bytes, np.uint8)
             return out
-        arr = np.frombuffer(arr_bytes, dtype=dtype).reshape(shape).copy()
+        arr = np.frombuffer(arr_bytes, dtype=dtype).reshape(shape)
+        if not arr.flags.writeable:
+            # Source buffer is immutable (bytes) — copy so callers get a
+            # normal writable array. Transport hands us its own bytearray,
+            # in which case the zero-copy view is safe to return as-is.
+            arr = arr.copy()
         if ndim == 0:
             return arr[()]  # scalars round-trip as numpy scalars
         return arr

@@ -80,6 +80,31 @@ def tcp_cluster(n: int, password: str = "", timeout: float = 20.0):
                 pass
 
 
+def _free_port_block(n: int, lo: int = 20000, hi: int = 60000) -> int:
+    """Find a base port such that base..base+n-1 are all bindable — needed
+    because mpirun assigns N *consecutive* ports from --port-base."""
+    import random
+
+    rng = random.Random()
+    with _port_lock:
+        for _ in range(200):
+            base = rng.randrange(lo, hi - n)
+            socks = []
+            try:
+                for p in range(base, base + n):
+                    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                    s.bind(("127.0.0.1", p))
+                    socks.append(s)
+                return base
+            except OSError:
+                continue
+            finally:
+                for s in socks:
+                    s.close()
+    raise RuntimeError("no free consecutive port block found")
+
+
 @pytest.fixture
 def cluster4():
     with tcp_cluster(4) as nets:

@@ -112,3 +112,26 @@ class TestErrors:
         wire = encode(np.arange(10.0))
         with pytest.raises(CodecError):
             decode(wire[:-3])
+
+
+class TestDtypeRouting:
+    def test_object_dtype_via_pickle(self):
+        import numpy as np
+        arr = np.array(["x", "yy", 3], dtype=object)
+        out = decode(encode(arr))
+        assert list(out) == ["x", "yy", 3]
+        assert out.dtype == object
+
+    def test_structured_dtype_roundtrips(self):
+        import numpy as np
+        arr = np.array([(1, 2.5), (3, 4.5)],
+                       dtype=[("a", "<i4"), ("b", "<f8")])
+        out = decode(encode(arr))
+        assert out.dtype == arr.dtype
+        assert out["a"].tolist() == [1, 3]
+
+    def test_bad_dtype_string_raises_codec_error(self):
+        import struct
+        wire = bytes([1, 3]) + b"zz9" + struct.pack("<B1I", 1, 1) + b"x" * 8
+        with pytest.raises(CodecError):
+            decode(wire)

@@ -324,3 +324,60 @@ class TestTwoRanks:
                     net.send(b"pong", dest=0, tag=1)
 
             run_on_ranks(nets, body)
+
+
+class TestCancelReceive:
+    def test_cancel_parked_receive(self, cluster4):
+        from mpi_tpu.backends.tcp import ReceiveCancelled
+
+        def body(net, r):
+            if r != 0:
+                return
+            box = []
+
+            def _recv():
+                try:
+                    net.receive(1, tag=55)
+                except BaseException as exc:  # noqa: BLE001
+                    box.append(exc)
+
+            t = threading.Thread(target=_recv, daemon=True)
+            t.start()
+            time.sleep(0.2)
+            assert net.cancel_receive(1, 55) is True
+            t.join(timeout=5)
+            assert box and isinstance(box[0], ReceiveCancelled)
+            # Tag must be reusable afterwards.
+            assert net.cancel_receive(1, 55) is False  # nothing pending
+
+        run_on_ranks(cluster4, body)
+
+    def test_stale_cancel_does_not_poison_next_claim(self, cluster4):
+        def body(net, r):
+            if r == 0:
+                box = []
+
+                def _recv():
+                    try:
+                        box.append(net.receive(1, tag=56))
+                    except BaseException as exc:  # noqa: BLE001
+                        box.append(exc)
+
+                t = threading.Thread(target=_recv, daemon=True)
+                t.start()
+                time.sleep(0.2)
+                net.cancel_receive(1, 56)
+                t.join(timeout=5)
+                # New receive on the same tag must work normally.
+                got = net.receive(1, tag=56)
+                assert got == b"fresh"
+            elif r == 1:
+                time.sleep(0.8)
+                net.send(b"fresh", dest=0, tag=56)
+
+        run_on_ranks(cluster4, body)
+
+    def test_send_before_init_raises_mpi_error(self):
+        net = TcpNetwork()
+        with pytest.raises(MpiError, match="before init"):
+            net.send(b"x", 0, 0)
