@@ -19,6 +19,7 @@ point-to-point library over TCP, /root/reference) designed TPU-first:
     ted SPMD code, plus Pallas ring/DMA kernels (:mod:`mpi_tpu.ops`).
 """
 
+from .runner import run_main, selected_backend
 from .api import (
     Interface,
     MpiError,
@@ -47,6 +48,8 @@ from .api import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "run_main",
+    "selected_backend",
     "Interface",
     "MpiError",
     "NotInitializedError",

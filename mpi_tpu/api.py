@@ -110,7 +110,11 @@ class Interface(Protocol):
 _lock = threading.Lock()
 _backend: Optional[Interface] = None
 _registered_explicitly = False
-_initialized = False
+# Reference-counted: under thread-per-rank backends (xla driver) every rank
+# thread calls init()/finalize() once, and one rank finishing early must
+# not tear the facade down under its siblings. Single-process drivers see
+# the same 0→1→0 behavior as the reference's boolean.
+_init_count = 0
 
 
 def _default_backend() -> Interface:
@@ -129,7 +133,7 @@ def register(impl: Interface) -> None:
     with _lock:
         if _registered_explicitly:
             raise MpiError("mpi_tpu: register called twice (mpi.go:63-65 contract)")
-        if _initialized:
+        if _init_count > 0:
             raise MpiError("mpi_tpu: register called after init")
         _backend = impl
         _registered_explicitly = True
@@ -144,17 +148,30 @@ def registered() -> Interface:
         return _backend
 
 
+def _release_backend(impl: Interface) -> None:
+    """Deregister ``impl`` if it is the active backend — used by re-runnable
+    hosts (``run_spmd``) so a second run in the same process can register
+    again. Not part of the reference surface (Register there is once per
+    process-lifetime, mpi.go:61-67); internal on purpose."""
+    global _backend, _registered_explicitly, _init_count
+    with _lock:
+        if _backend is impl:
+            _backend = None
+            _registered_explicitly = False
+            _init_count = 0
+
+
 def _reset_for_testing() -> None:
     """Clear global registry state (no reference analogue; test hook)."""
-    global _backend, _registered_explicitly, _initialized
+    global _backend, _registered_explicitly, _init_count
     with _lock:
         _backend = None
         _registered_explicitly = False
-        _initialized = False
+        _init_count = 0
 
 
 def _require_init() -> Interface:
-    if not _initialized:
+    if _init_count <= 0:
         raise NotInitializedError("mpi_tpu: call init() first (mpi.go:26-30)")
     return registered()
 
@@ -162,20 +179,22 @@ def _require_init() -> Interface:
 def init() -> None:
     """Initialize the communication network (mpi.go:96-98). Blocks until
     every rank has connected (network.go:53-65)."""
-    global _initialized
+    global _init_count
     impl = registered()
     impl.init()
     with _lock:
-        _initialized = True
+        _init_count += 1
 
 
 def finalize() -> None:
     """Tear down the network (mpi.go:102-104)."""
-    global _initialized
+    global _init_count
     impl = registered()
-    impl.finalize()
     with _lock:
-        _initialized = False
+        _init_count = max(0, _init_count - 1)
+        last = _init_count == 0
+    if last:
+        impl.finalize()
 
 
 def rank() -> int:

@@ -1,0 +1,186 @@
+"""Transport-agnostic rendezvous primitives shared by the drivers.
+
+Extracted from the TCP driver so the XLA driver's in-process rank threads
+reuse exactly the same tag bookkeeping and first-arrival-creates handoff
+semantics (network.go:371-446, 449-497) — one implementation, one set of
+misuse-detection rules, every backend.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ..api import MpiError, TagError
+
+__all__ = ["Cancel", "ReceiveCancelled", "TagManager", "Rendezvous"]
+
+
+class ReceiveCancelled(MpiError):
+    """A pending receive was cancelled via ``cancel_receive`` (used by
+    :func:`mpi_tpu.api.exchange` to clean up after a failed send)."""
+
+
+class Cancel:
+    """Cancellation token routed into a tag slot. Carries the claim
+    generation it targets so a token that loses a race with real data
+    cannot poison a *later* claim of the same tag."""
+
+    def __init__(self, gen: int, exc: BaseException):
+        self.gen = gen
+        self.exc = exc
+
+
+class TagManager:
+    """Per-direction, per-peer tag → slot map with misuse detection.
+
+    Rebuild of ``tagManager`` (network.go:449-497): a duplicate live tag is
+    an error (the reference panics, network.go:469); early arrivals for
+    unregistered tags are buffered; cancellation is generation-tagged."""
+
+    def __init__(self, direction: str, peer: int):
+        self._direction = direction
+        self._peer = peer
+        self._lock = threading.Lock()
+        self._slots: Dict[int, queue.Queue] = {}
+        self._claimed: set = set()
+        self._gen: Dict[int, int] = {}
+        self._dead: Optional[BaseException] = None
+
+    def claim(self, tag: int) -> Tuple[queue.Queue, int]:
+        """Register a live caller-side use of ``tag`` (send or receive).
+        Returns the slot and this claim's generation."""
+        with self._lock:
+            if self._dead is not None:
+                raise self._dead
+            if tag in self._claimed:
+                raise TagError(tag, self._peer, self._direction)
+            self._claimed.add(tag)
+            gen = self._gen.get(tag, 0) + 1
+            self._gen[tag] = gen
+            return self._slots.setdefault(tag, queue.Queue()), gen
+
+    def cancel(self, tag: int, exc: BaseException) -> bool:
+        """Best-effort cancel of the live claim on ``tag``."""
+        with self._lock:
+            if tag not in self._claimed:
+                return False
+            q = self._slots.setdefault(tag, queue.Queue())
+            gen = self._gen.get(tag, 0)
+        q.put(Cancel(gen, exc))
+        return True
+
+    def release(self, tag: int) -> None:
+        with self._lock:
+            self._claimed.discard(tag)
+            q = self._slots.get(tag)
+            if q is not None and q.empty():
+                del self._slots[tag]
+
+    def route(self, tag: int, item: Any) -> None:
+        """Deliver an inbound item to the tag's slot (creating it if the
+        matching call hasn't arrived yet)."""
+        with self._lock:
+            q = self._slots.setdefault(tag, queue.Queue())
+        q.put(item)
+
+    def poison(self, exc: BaseException) -> None:
+        """Fail all pending and future operations on this direction."""
+        with self._lock:
+            self._dead = exc
+            slots = list(self._slots.values())
+        for q in slots:
+            q.put(exc)
+
+    def wait(self, slot: queue.Queue, gen: int) -> Any:
+        """Block on ``slot`` for data, handling cancellation tokens and
+        routed exceptions. Returns the payload."""
+        while True:
+            item = slot.get()
+            if isinstance(item, Cancel):
+                if item.gen == gen:
+                    raise item.exc
+                continue  # stale token from an earlier claim — drop
+            if isinstance(item, BaseException):
+                raise item
+            return item
+
+
+class Rendezvous:
+    """Blocking first-arrival-creates handoff between one sender side and
+    one receiver side, keyed by tag (network.go:371-446).
+
+    Used for the self-send path in the TCP driver and for every rank pair
+    in the in-process XLA driver. A second arrival from the *same* side
+    while an entry is live is the misuse the reference panics on
+    (network.go:417,435) — here it raises :class:`TagError`."""
+
+    _SENDER, _RECEIVER = "send", "receive"
+
+    class _Entry:
+        __slots__ = ("creator", "q", "done", "sender_engaged")
+
+        def __init__(self, creator: str):
+            self.creator = creator
+            self.q: queue.Queue = queue.Queue(maxsize=1)
+            self.done = threading.Event()
+            self.sender_engaged = False
+
+    def __init__(self, send_peer: int, recv_peer: int):
+        # Peer ranks reported in TagError messages: a duplicate send names
+        # the destination, a duplicate receive names the source.
+        self._send_peer = send_peer
+        self._recv_peer = recv_peer
+        self._lock = threading.Lock()
+        self._entries: Dict[int, "Rendezvous._Entry"] = {}
+
+    def _entry(self, tag: int, side: str) -> "Rendezvous._Entry":
+        with self._lock:
+            ent = self._entries.get(tag)
+            if ent is None:
+                ent = Rendezvous._Entry(side)
+                self._entries[tag] = ent
+            elif ent.creator == side:
+                peer = self._send_peer if side == self._SENDER else self._recv_peer
+                raise TagError(tag, peer, side)
+            if side == self._SENDER:
+                # Marked under the lock, *before* the sender's q.put runs,
+                # so cancel() can never retire an entry a sender is about
+                # to fill (which would wedge the sender forever).
+                ent.sender_engaged = True
+            return ent
+
+    def cancel(self, tag: int, exc: BaseException) -> bool:
+        """Best-effort cancel of a parked receive: only succeeds while no
+        sender has engaged the entry."""
+        with self._lock:
+            ent = self._entries.get(tag)
+            if ent is None:
+                return False
+            if ent.creator != self._RECEIVER or ent.sender_engaged:
+                return False
+            self._entries.pop(tag)
+        try:
+            ent.q.put_nowait(Cancel(0, exc))
+            return True
+        except queue.Full:  # pragma: no cover - sender_engaged excludes this
+            return False
+
+    def send(self, tag: int, payload: Any) -> None:
+        ent = self._entry(tag, self._SENDER)
+        ent.q.put(payload)
+        ent.done.wait()  # rendezvous: return only after receiver took it
+
+    def receive(self, tag: int) -> Any:
+        ent = self._entry(tag, self._RECEIVER)
+        payload = ent.q.get()
+        if isinstance(payload, Cancel):
+            raise payload.exc
+        # The receiver retires the entry *before* signalling the sender:
+        # popping under the lock here closes a race where a second legal
+        # use of the same tag could observe the drained entry and deadlock.
+        with self._lock:
+            self._entries.pop(tag, None)
+        ent.done.set()
+        return payload

@@ -50,7 +50,6 @@ Wire protocol (replaces gob; all integers little-endian)::
 
 from __future__ import annotations
 
-import queue
 import socket
 import struct
 import threading
@@ -58,11 +57,12 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import flags as flagmod
-from ..api import MpiError, TagError
+from ..api import MpiError
 from ..utils.serialize import decode as codec_decode
 from ..utils.serialize import encode as codec_encode
+from .rendezvous import ReceiveCancelled, Rendezvous, TagManager
 
-__all__ = ["TcpNetwork"]
+__all__ = ["TcpNetwork", "InitError", "ReceiveCancelled"]
 
 KIND_DATA = 0
 KIND_ACK = 1
@@ -112,142 +112,6 @@ def _recv_frame(sock: socket.socket) -> Tuple[int, int, bytearray]:
     return kind, tag, payload
 
 
-class ReceiveCancelled(MpiError):
-    """A pending receive was cancelled via ``cancel_receive`` (used by
-    :func:`mpi_tpu.api.exchange` to clean up after a failed send)."""
-
-
-class _Cancel:
-    """Cancellation token routed into a tag slot. Carries the claim
-    generation it targets so a token that loses a race with real data
-    cannot poison a *later* claim of the same tag."""
-
-    def __init__(self, gen: int, exc: BaseException):
-        self.gen = gen
-        self.exc = exc
-
-
-class _TagManager:
-    """Per-direction, per-peer tag → rendezvous-slot map.
-
-    Rebuild of ``tagManager`` (network.go:449-497) with the same misuse
-    detection (duplicate live tag → error instead of panic), plus buffering
-    of early arrivals (see module doc) and generation-tagged cancellation."""
-
-    def __init__(self, direction: str, peer: int):
-        self._direction = direction
-        self._peer = peer
-        self._lock = threading.Lock()
-        self._slots: Dict[int, queue.Queue] = {}
-        self._claimed: set = set()
-        self._gen: Dict[int, int] = {}
-        self._dead: Optional[BaseException] = None
-
-    def claim(self, tag: int) -> Tuple[queue.Queue, int]:
-        """Register a live caller-side use of ``tag`` (send or receive).
-        Returns the slot and this claim's generation."""
-        with self._lock:
-            if self._dead is not None:
-                raise self._dead
-            if tag in self._claimed:
-                raise TagError(tag, self._peer, self._direction)
-            self._claimed.add(tag)
-            gen = self._gen.get(tag, 0) + 1
-            self._gen[tag] = gen
-            return self._slots.setdefault(tag, queue.Queue()), gen
-
-    def cancel(self, tag: int, exc: BaseException) -> bool:
-        """Best-effort cancel of the live claim on ``tag``."""
-        with self._lock:
-            if tag not in self._claimed:
-                return False
-            q = self._slots.setdefault(tag, queue.Queue())
-            gen = self._gen.get(tag, 0)
-        q.put(_Cancel(gen, exc))
-        return True
-
-    def release(self, tag: int) -> None:
-        with self._lock:
-            self._claimed.discard(tag)
-            q = self._slots.get(tag)
-            if q is not None and q.empty():
-                del self._slots[tag]
-
-    def route(self, tag: int, item: Any) -> None:
-        """Deliver an inbound frame to the tag's slot (creating it if the
-        matching call hasn't arrived yet)."""
-        with self._lock:
-            q = self._slots.setdefault(tag, queue.Queue())
-        q.put(item)
-
-
-class _LocalRendezvous:
-    """In-process self-send path (network.go:371-446).
-
-    First arrival (sender or receiver) creates the per-tag entry and
-    records which side created it; a second arrival from the *same* side
-    while the entry is live is the misuse the reference panics on
-    (network.go:417,435) — here it raises :class:`TagError`. The entry is
-    removed once the handoff completes."""
-
-    _SENDER, _RECEIVER = "send(self)", "receive(self)"
-
-    def __init__(self, myrank: int):
-        self._rank = myrank
-        self._lock = threading.Lock()
-        self._entries: Dict[int, Tuple[str, queue.Queue, threading.Event]] = {}
-
-    def _entry(self, tag: int, side: str) -> Tuple[queue.Queue, threading.Event]:
-        with self._lock:
-            ent = self._entries.get(tag)
-            if ent is None:
-                q: queue.Queue = queue.Queue(maxsize=1)
-                done = threading.Event()
-                self._entries[tag] = (side, q, done)
-                return q, done
-            creator, q, done = ent
-            if creator == side:
-                raise TagError(tag, self._rank, side)
-            return q, done
-
-    def send(self, tag: int, payload: bytes) -> None:
-        q, done = self._entry(tag, self._SENDER)
-        q.put(payload)
-        done.wait()  # rendezvous: return only after receiver took it
-
-    def cancel(self, tag: int, exc: BaseException) -> bool:
-        """Best-effort cancel of a parked self-receive: only succeeds while
-        no sender has engaged (entry created by the receiver, still empty)."""
-        with self._lock:
-            ent = self._entries.get(tag)
-            if ent is None:
-                return False
-            creator, q, _done = ent
-            if creator != self._RECEIVER or not q.empty():
-                return False
-            self._entries.pop(tag)
-        try:
-            q.put_nowait(_Cancel(0, exc))
-            return True
-        except queue.Full:
-            return False
-
-    def receive(self, tag: int) -> bytes:
-        q, done = self._entry(tag, self._RECEIVER)
-        payload = q.get()
-        if isinstance(payload, _Cancel):
-            raise payload.exc
-        # The receiver retires the entry *before* signalling the sender:
-        # popping under the lock here (rather than in send() after
-        # done.wait(), as the reference's sender-side delete does,
-        # network.go:427-429) closes a race where a second legal use of the
-        # same tag could observe the drained entry and deadlock.
-        with self._lock:
-            self._entries.pop(tag, None)
-        done.set()
-        return payload
-
-
 class _Peer:
     """Connection pair to one peer (``pairwiseConnection``, network.go:499-506)."""
 
@@ -257,8 +121,8 @@ class _Peer:
         self.listen_sock: Optional[socket.socket] = None  # their sends + my acks
         self.dial_lock = threading.Lock()
         self.listen_lock = threading.Lock()
-        self.sendtags = _TagManager("send", peer_rank)
-        self.receivetags = _TagManager("receive", peer_rank)
+        self.sendtags = TagManager("send", peer_rank)
+        self.receivetags = TagManager("receive", peer_rank)
         self.reader_threads: List[threading.Thread] = []
 
 
@@ -283,7 +147,7 @@ class TcpNetwork:
         self._rank: Optional[int] = None
         self._size: Optional[int] = None
         self._peers: Dict[int, _Peer] = {}
-        self._local: Optional[_LocalRendezvous] = None
+        self._local: Optional[Rendezvous] = None
         self._listener: Optional[socket.socket] = None
         self._closed = threading.Event()
         self._initialized = False
@@ -311,7 +175,7 @@ class TcpNetwork:
             self.addr = self.addr or ":5000"
             self.addrs = [self.addr]
         self._assign_ranks()
-        self._local = _LocalRendezvous(self._rank)
+        self._local = Rendezvous(self._rank, self._rank)
         self._start_connections()
         self._initialized = True
 
@@ -350,12 +214,11 @@ class TcpNetwork:
             self._local.send(tag, payload)
             return
         peer = self._peers[dest]
-        ackq, _gen = peer.sendtags.claim(tag)
+        ackq, gen = peer.sendtags.claim(tag)
         try:
             _send_frame(peer.dial_sock, peer.dial_lock, KIND_DATA, tag, payload)
-            ack = ackq.get()  # blocks until receiver's ack (network.go:569)
-            if isinstance(ack, BaseException):
-                raise ack
+            # Blocks until the receiver's ack (network.go:569).
+            peer.sendtags.wait(ackq, gen)
         finally:
             peer.sendtags.release(tag)
 
@@ -368,15 +231,7 @@ class TcpNetwork:
         peer = self._peers[source]
         slot, gen = peer.receivetags.claim(tag)
         try:
-            while True:
-                payload = slot.get()
-                if isinstance(payload, _Cancel):
-                    if payload.gen == gen:
-                        raise payload.exc
-                    continue  # stale token from an earlier claim — drop
-                if isinstance(payload, BaseException):
-                    raise payload
-                break
+            payload = peer.receivetags.wait(slot, gen)
             # Ack on the listen conn — this is what unblocks the sender's
             # rendezvous (network.go:617-624); written only now, when the
             # receive has genuinely accepted the data.
@@ -609,18 +464,14 @@ class TcpNetwork:
         except (ConnectionError, OSError, MpiError) as exc:
             self._poison(peer.receivetags, exc)
 
-    def _poison(self, tags: _TagManager, exc: BaseException) -> None:
+    def _poison(self, tags: TagManager, exc: BaseException) -> None:
         """On connection loss, fail all pending *and future* ops on this
         direction instead of hanging (replaces the reference's reader
         panics, network.go:555,611): ops already blocked get the exception
         via their slot; ops issued after the loss fail at claim()."""
         if self._closed.is_set():
             exc = MpiError("mpi_tpu: network finalized")
-        with tags._lock:
-            tags._dead = exc
-            slots = list(tags._slots.values())
-        for q in slots:
-            q.put(exc)
+        tags.poison(exc)
 
     def _check_rank(self, r: int) -> None:
         if self._size is None:

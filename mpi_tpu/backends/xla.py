@@ -1,0 +1,486 @@
+"""XLA driver — ranks on a device mesh, collectives over ICI.
+
+The tpu-native realization of the reference's process model (SURVEY.md §7,
+BASELINE.json north_star). Where the reference maps rank → OS process and
+moves bytes over TCP (network.go), this driver maps **rank → device** on a
+:class:`jax.sharding.Mesh` axis inside one process:
+
+  * ``init``/``finalize`` — mesh construction + a rank barrier, replacing
+    the O(N²) socket handshake (network.go:122-351): XLA already knows the
+    slice topology, so bootstrap is local;
+  * ``send``/``receive`` — blocking tagged rendezvous between rank threads
+    (exactly the reference's contract, mpi.go:122-159) with device-to-device
+    array movement (``jax.device_put`` → ICI transfer on TPU slices);
+  * collectives — the north star: array payloads are assembled into one
+    global sharded array and reduced by a **single compiled XLA collective**
+    over the mesh (``mpi_tpu.parallel.collectives``), which rides ICI.
+    ``deterministic=True`` uses the canonical binomial tree for
+    bitwise-identical results to the TCP driver. Object payloads
+    (strings, dicts, ...) use in-process handoff.
+
+Programming model. The reference is SPMD-by-processes: one binary, N
+processes, behavior branches on ``Rank()`` (mpi.go:8-14). Here the same
+user code runs SPMD-by-threads: :func:`run_spmd` launches one thread per
+rank, each bound to its device, so reference-style programs (helloworld,
+bounce) run unmodified on a v4-8 — while ``jit``-heavy code is free to use
+the functional layer directly for zero-overhead collectives inside a
+single trace.
+
+Single-process scope: this driver covers every rank the process can
+address (a full v4-8). Multi-host DCN spans are the hybrid driver's job
+(hierarchical: XLA within a host, TCP across hosts — see
+``mpi_tpu.backends.hybrid``).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import MpiError
+from .rendezvous import ReceiveCancelled, Rendezvous
+
+__all__ = ["XlaNetwork", "run_spmd"]
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# --------------------------------------------------------------------------
+# Rank-binding inheritance.
+#
+# The reference's rank is per-*process*, so any goroutine may call
+# Send/Receive (helloworld.go:53-81 does exactly that). Here a rank is a
+# per-*thread* binding, so threads the user spawns (and the facade's own
+# sendrecv helper) would come up unbound. While any run_spmd is active,
+# Thread.start is wrapped so a thread started by a bound thread inherits
+# its binding — reference-style threaded programs run unmodified.
+# --------------------------------------------------------------------------
+
+_patch_lock = threading.Lock()
+_active_networks: List["XlaNetwork"] = []
+_orig_thread_start = threading.Thread.start
+
+
+def _patched_start(self: threading.Thread) -> None:
+    # Runs in the *parent* thread: snapshot its bindings for the child.
+    bindings = [(net, net._tls.rank) for net in list(_active_networks)
+                if getattr(net._tls, "rank", None) is not None]
+    if bindings and not getattr(self, "_mpi_rank_bound", False):
+        orig_run = self.run
+
+        def run_bound() -> None:
+            for net, r in bindings:
+                net._tls.rank = r
+            orig_run()
+
+        self.run = run_bound
+        self._mpi_rank_bound = True
+    _orig_thread_start(self)
+
+
+def _activate_inheritance(net: "XlaNetwork") -> None:
+    with _patch_lock:
+        _active_networks.append(net)
+        if threading.Thread.start is _orig_thread_start:
+            threading.Thread.start = _patched_start
+
+
+def _deactivate_inheritance(net: "XlaNetwork") -> None:
+    with _patch_lock:
+        if net in _active_networks:
+            _active_networks.remove(net)
+        if not _active_networks:
+            threading.Thread.start = _orig_thread_start
+
+
+class _CollectiveSession:
+    """Rank-thread synchronization for native collectives.
+
+    Every rank contributes its payload, a barrier fires, the leader (one
+    arbitrary barrier winner) runs the combined computation once, a second
+    barrier releases everyone to read their result. Reusable across
+    sequential collectives (threading.Barrier auto-resets); collectives
+    must be invoked in the same order by all ranks — the standard MPI
+    requirement the generic layer documents too."""
+
+    def __init__(self, n: int):
+        self._n = n
+        self._barrier = threading.Barrier(n)
+        self._slots: List[Any] = [None] * n
+        self._results: List[Any] = [None] * n
+        self._error: Optional[BaseException] = None
+
+    def run(self, rank: int, value: Any,
+            leader: Callable[[List[Any]], List[Any]]) -> Any:
+        self._slots[rank] = value
+        try:
+            arrival = self._barrier.wait()
+        except threading.BrokenBarrierError as exc:
+            raise MpiError(
+                "mpi_tpu: collective aborted (another rank failed)") from exc
+        if arrival == 0:
+            try:
+                self._results = leader(list(self._slots))
+                self._error = None
+            except BaseException as exc:  # noqa: BLE001 - re-raised on all ranks
+                self._error = exc
+        try:
+            self._barrier.wait()
+        except threading.BrokenBarrierError as exc:
+            raise MpiError(
+                "mpi_tpu: collective aborted (another rank failed)") from exc
+        if self._error is not None:
+            raise MpiError(
+                f"mpi_tpu: collective failed on leader: {self._error}"
+            ) from self._error
+        return self._results[rank]
+
+
+class XlaNetwork:
+    """Backend implementing the :class:`mpi_tpu.api.Interface` SPI over a
+    device mesh. Construct with the rank count (defaults to every visible
+    device) and hand user code to :func:`run_spmd`."""
+
+    def __init__(self, n: Optional[int] = None,
+                 devices: Optional[Sequence[Any]] = None,
+                 deterministic_collectives: bool = False):
+        jax = _jax()
+        from ..parallel.mesh import make_mesh
+
+        if devices is None:
+            devices = jax.devices()[: n] if n is not None else jax.devices()
+        if n is not None and len(devices) < n:
+            raise MpiError(
+                f"mpi_tpu: need {n} devices for {n} ranks, have {len(devices)}")
+        self._devices = list(devices)
+        self._n = len(self._devices)
+        self._mesh = make_mesh(devices=self._devices)
+        self._tls = threading.local()
+        self._init_barrier = threading.Barrier(self._n)
+        self._coll = _CollectiveSession(self._n)
+        # One rendezvous per ordered (src, dst) pair, created lazily.
+        self._pairs: Dict[Tuple[int, int], Rendezvous] = {}
+        self._pairs_lock = threading.Lock()
+        self._jit_cache: Dict[Tuple, Any] = {}
+        self._initialized = False
+        self.deterministic_collectives = deterministic_collectives
+
+    # -- rank binding --------------------------------------------------------
+
+    def bind_rank(self, rank: int) -> None:
+        """Associate the calling thread with ``rank`` (run_spmd does this)."""
+        if not 0 <= rank < self._n:
+            raise MpiError(f"mpi_tpu: rank {rank} out of range [0, {self._n})")
+        self._tls.rank = rank
+
+    def _myrank(self) -> int:
+        r = getattr(self._tls, "rank", None)
+        if r is None:
+            if self._n == 1:
+                return 0
+            raise MpiError(
+                "mpi_tpu: calling thread has no rank binding — run your "
+                "program under mpi_tpu.backends.xla.run_spmd(fn, n)")
+        return r
+
+    def device(self, rank: Optional[int] = None):
+        """The jax device backing ``rank`` (default: calling thread's)."""
+        return self._devices[self._myrank() if rank is None else rank]
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    # -- Interface ------------------------------------------------------------
+
+    def init(self) -> None:
+        """Barrier across all rank threads (the bootstrap analogue —
+        network.go:122-159 collapses to a thread barrier because XLA
+        already knows the topology)."""
+        self._myrank()  # validates binding
+        if self._n > 1:
+            try:
+                self._init_barrier.wait(timeout=60.0)
+            except threading.BrokenBarrierError as exc:
+                raise MpiError(
+                    "mpi_tpu: init barrier broken (a rank failed to start)"
+                ) from exc
+        self._initialized = True
+
+    def finalize(self) -> None:
+        self._initialized = False
+
+    def rank(self) -> int:
+        return self._myrank()
+
+    def size(self) -> int:
+        return self._n
+
+    # -- point-to-point -------------------------------------------------------
+
+    def _pair(self, src: int, dst: int) -> Rendezvous:
+        key = (src, dst)
+        with self._pairs_lock:
+            rv = self._pairs.get(key)
+            if rv is None:
+                rv = Rendezvous(send_peer=dst, recv_peer=src)
+                self._pairs[key] = rv
+            return rv
+
+    def send(self, data: Any, dest: int, tag: int) -> None:
+        """Blocking rendezvous send. Array payloads are moved to the
+        destination rank's device (ICI hop on TPU); host objects are
+        copied, preserving the reference's value semantics (gob round-trip
+        implies the receiver never aliases sender memory)."""
+        me = self._myrank()
+        self._check_rank(dest)
+        jax = _jax()
+        if isinstance(data, jax.Array):
+            payload = jax.device_put(data, self._devices[dest])
+        elif isinstance(data, np.ndarray):
+            payload = data.copy()
+        elif isinstance(data, (bytes, str, int, float, bool, complex,
+                               type(None))):
+            payload = data  # immutable
+        else:
+            payload = copy.deepcopy(data)
+        self._pair(me, dest).send(tag, payload)
+
+    def receive(self, source: int, tag: int, out: Optional[Any] = None) -> Any:
+        me = self._myrank()
+        self._check_rank(source)
+        payload = self._pair(source, me).receive(tag)
+        if out is not None and isinstance(out, np.ndarray) \
+                and isinstance(payload, np.ndarray) \
+                and out.shape == payload.shape and out.dtype == payload.dtype:
+            out[...] = payload
+            return out
+        return payload
+
+    def cancel_receive(self, source: int, tag: int) -> bool:
+        me = self._myrank()
+        self._check_rank(source)
+        exc = ReceiveCancelled(
+            f"mpi_tpu: receive(source={source}, tag={tag}) cancelled")
+        return self._pair(source, me).cancel(tag, exc)
+
+    def _check_rank(self, r: int) -> None:
+        if not 0 <= r < self._n:
+            raise MpiError(f"mpi_tpu: peer rank {r} out of range [0, {self._n})")
+
+    # -- native collectives ---------------------------------------------------
+
+    def _global_array(self, slots: List[np.ndarray]):
+        """Stack per-rank payloads into one mesh-sharded global array
+        (shard i on device i) — the input format XLA collectives want."""
+        jax = _jax()
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shape = slots[0].shape
+        dtype = slots[0].dtype
+        for i, s in enumerate(slots):
+            if s.shape != shape or s.dtype != dtype:
+                raise MpiError(
+                    f"mpi_tpu: collective payload mismatch: rank 0 has "
+                    f"{shape}/{dtype}, rank {i} has {s.shape}/{s.dtype}")
+        if dtype.itemsize == 8 and dtype.kind in "fiu" \
+                and not jax.config.jax_enable_x64:
+            raise MpiError(
+                f"mpi_tpu: {dtype} collective payload would silently "
+                f"downcast — enable 64-bit mode (JAX_ENABLE_X64=1 or "
+                f"jax.config.update('jax_enable_x64', True)) or send "
+                f"32-bit data")
+        sharding = NamedSharding(self._mesh, P("rank"))
+        shards = [
+            jax.device_put(np.asarray(s)[None], d)
+            for s, d in zip(slots, self._devices)
+        ]
+        return jax.make_array_from_single_device_arrays(
+            (self._n, *shape), sharding, shards)
+
+    def _per_rank(self, global_arr) -> List[np.ndarray]:
+        """Split a (n, ...) mesh-sharded result back into per-rank arrays."""
+        shards = sorted(global_arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return [np.asarray(s.data)[0] for s in shards]
+
+    def _collective_fn(self, kind: str, op: str, deterministic: bool):
+        key = (kind, op, deterministic)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        jax = _jax()
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel import collectives as C
+
+        if kind == "allreduce":
+            def per_shard(x):
+                # x: (1, *shape) block; reduce over the mesh axis.
+                return C.allreduce(x, "rank", op=op,
+                                   deterministic=deterministic)
+        else:  # pragma: no cover - future kinds
+            raise MpiError(f"unknown collective kind {kind}")
+
+        fn = jax.jit(jax.shard_map(per_shard, mesh=self._mesh,
+                                   in_specs=P("rank"), out_specs=P("rank"),
+                                   check_vma=False))
+        self._jit_cache[key] = fn
+        return fn
+
+    def allreduce(self, data: Any, op: str = "sum",
+                  deterministic: Optional[bool] = None) -> Any:
+        """North-star collective: one XLA reduction over the mesh.
+
+        Payloads must be numeric (anything ``np.asarray`` maps to a
+        numeric dtype, matching what the generic driver can reduce);
+        a non-numeric payload raises on every rank."""
+        det = (self.deterministic_collectives if deterministic is None
+               else deterministic)
+        me = self._myrank()
+
+        def leader(slots: List[Any]) -> List[Any]:
+            np_slots = [np.asarray(s) for s in slots]
+            if np_slots[0].dtype.kind not in "fiubc":
+                raise MpiError(
+                    f"mpi_tpu: allreduce requires numeric payloads, got "
+                    f"dtype {np_slots[0].dtype}")
+            scalar = np_slots[0].ndim == 0
+            garr = self._global_array(np_slots)
+            out = self._collective_fn("allreduce", op, det)(garr)
+            per = self._per_rank(out)
+            if scalar:
+                per = [p[()] for p in per]
+            return per
+
+        from ..collectives_generic import check_op
+
+        check_op(op)
+        return self._coll.run(me, data, leader)
+
+    def barrier(self) -> None:
+        self._coll.run(self._myrank(), None, lambda slots: [None] * self._n)
+
+    def bcast(self, data: Any, root: int = 0) -> Any:
+        self._check_rank(root)
+
+        def leader(slots: List[Any]) -> List[Any]:
+            payload = slots[root]
+            return [payload if i == root else copy.deepcopy(payload)
+                    for i in range(self._n)]
+
+        return self._coll.run(self._myrank(), data, leader)
+
+    def gather(self, data: Any, root: int = 0) -> Optional[List[Any]]:
+        self._check_rank(root)
+
+        def leader(slots: List[Any]) -> List[Any]:
+            return [list(slots) if i == root else None
+                    for i in range(self._n)]
+
+        return self._coll.run(self._myrank(), data, leader)
+
+    def allgather(self, data: Any) -> List[Any]:
+        def leader(slots: List[Any]) -> List[Any]:
+            return [list(slots) for _ in range(self._n)]
+
+        return self._coll.run(self._myrank(), data, leader)
+
+    def scatter(self, data: Optional[List[Any]], root: int = 0) -> Any:
+        self._check_rank(root)
+
+        def leader(slots: List[Any]) -> List[Any]:
+            items = slots[root]
+            if items is None or len(items) != self._n:
+                raise MpiError(
+                    f"mpi_tpu: scatter root needs a list of exactly "
+                    f"{self._n} payloads")
+            return list(items)
+
+        return self._coll.run(self._myrank(), data, leader)
+
+    def alltoall(self, data: List[Any]) -> List[Any]:
+        if len(data) != self._n:
+            raise MpiError(
+                f"mpi_tpu: alltoall needs exactly {self._n} payloads, "
+                f"got {len(data)}")
+
+        def leader(slots: List[List[Any]]) -> List[List[Any]]:
+            return [[slots[src][dst] for src in range(self._n)]
+                    for dst in range(self._n)]
+
+        return self._coll.run(self._myrank(), data, leader)
+
+    def reduce(self, data: Any, root: int = 0, op: str = "sum") -> Optional[Any]:
+        self._check_rank(root)
+        result = self.allreduce(data, op=op)
+        return result if self._myrank() == root else None
+
+
+def run_spmd(fn: Callable[[], Any], n: Optional[int] = None,
+             net: Optional[XlaNetwork] = None,
+             register_facade: bool = True) -> List[Any]:
+    """Run ``fn`` SPMD: one thread per rank, each bound to a mesh device —
+    the in-process analogue of ``gompirun N prog`` (gompirun.go:28-93).
+
+    ``fn`` is reference-style user code: it calls ``mpi_tpu.init()``,
+    branches on ``mpi_tpu.rank()``, communicates, ``mpi_tpu.finalize()``.
+    Returns the per-rank return values. The first rank exception is
+    re-raised after all threads stop."""
+    from .. import api
+
+    network = net or XlaNetwork(n=n)
+    if register_facade:
+        api.register(network)
+    nranks = network.size()
+    results: List[Any] = [None] * nranks
+    errors: List[Optional[BaseException]] = [None] * nranks
+    _activate_inheritance(network)
+
+    def runner(r: int) -> None:
+        network.bind_rank(r)
+        try:
+            results[r] = fn()
+        except BaseException as exc:  # noqa: BLE001 - aggregated below
+            errors[r] = exc
+            network._init_barrier.abort()
+            network._coll._barrier.abort()
+
+    threads = [threading.Thread(target=runner, args=(r,),
+                                name=f"mpi-rank-{r}", daemon=True)
+               for r in range(nranks)]
+    for t in threads:
+        t.start()
+    # Join, but once any rank has errored give stragglers a bounded grace
+    # period (a failed partner can leave a rank parked in a rendezvous that
+    # will never complete — don't hang the launcher on it).
+    import time as _time
+
+    try:
+        deadline: Optional[float] = None
+        while True:
+            alive = [t for t in threads if t.is_alive()]
+            if not alive:
+                break
+            if any(e is not None for e in errors):
+                if deadline is None:
+                    deadline = _time.monotonic() + 10.0
+                elif _time.monotonic() > deadline:
+                    break
+            for t in alive:
+                t.join(timeout=0.1)
+    finally:
+        _deactivate_inheritance(network)
+        if register_facade:
+            api._release_backend(network)
+    for e in errors:
+        if e is not None:
+            raise e
+    return results

@@ -151,12 +151,17 @@ def set_argv_for_testing(argv: Optional[Sequence[str]]) -> None:
     _argv_override = argv
 
 
-def _scan_argv(argv: Sequence[str]) -> Dict[str, str]:
-    """Extract ``-mpi-*`` flags from argv, ignoring everything else.
+def _scan_argv(argv: Sequence[str],
+               names: Optional[set] = None) -> Dict[str, str]:
+    """Extract the given flags from argv, ignoring everything else.
 
     Accepts ``-name value``, ``--name value``, ``-name=value``,
-    ``--name=value``.
+    ``--name=value``. ``names`` defaults to the five ``-mpi-*`` flags;
+    the runner passes its own set (``mpi-backend``/``mpi-ranks``) so there
+    is exactly one argv grammar in the package.
     """
+    if names is None:
+        names = _FLAG_NAMES
     found: Dict[str, str] = {}
     i = 0
     while i < len(argv):
@@ -165,14 +170,22 @@ def _scan_argv(argv: Sequence[str]) -> Dict[str, str]:
             body = tok.lstrip("-")
             if "=" in body:
                 name, _, value = body.partition("=")
-                if name in _FLAG_NAMES:
+                if name in names:
                     found[name] = value
-            elif body in _FLAG_NAMES:
+            elif body in names:
                 if i + 1 < len(argv):
                     found[body] = argv[i + 1]
                     i += 1
         i += 1
     return found
+
+
+def scan_argv(names: set, argv: Optional[Sequence[str]] = None) -> Dict[str, str]:
+    """Public scanner for extension flags, honoring the same argv source
+    override (:func:`set_argv_for_testing`) as the core five."""
+    if argv is None:
+        argv = _argv_override if _argv_override is not None else sys.argv[1:]
+    return _scan_argv(argv, names)
 
 
 def parse_flags(argv: Optional[Sequence[str]] = None,

@@ -1,0 +1,31 @@
+"""Functional SPMD layer — jittable collectives and mesh utilities.
+
+This is the idiomatic TPU path: use these *inside* ``jax.jit``/``shard_map``
+code over a :class:`jax.sharding.Mesh`. The imperative MPI-style facade
+(:mod:`mpi_tpu.api` + :mod:`mpi_tpu.backends.xla`) builds on the same
+functions, so both programming models lower to identical XLA collectives.
+"""
+
+from .mesh import make_mesh, mesh_devices, rank_axis
+from .collectives import (
+    allgather,
+    allreduce,
+    alltoall,
+    bcast,
+    pshift,
+    reduce_scatter,
+    tree_allreduce,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_devices",
+    "rank_axis",
+    "allgather",
+    "allreduce",
+    "alltoall",
+    "bcast",
+    "pshift",
+    "reduce_scatter",
+    "tree_allreduce",
+]

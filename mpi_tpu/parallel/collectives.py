@@ -1,0 +1,183 @@
+"""Jittable collectives over a mesh axis — the north-star layer.
+
+The reference stubs collectives out entirely (mpi.go:130 commented-out
+``AllReduce``); this module supplies them tpu-natively: every function here
+is traceable under ``jax.jit`` inside ``shard_map`` and lowers to XLA
+collectives (``psum``/``all_gather``/``ppermute``/``all_to_all``) that ride
+ICI on a TPU slice.
+
+Two reduction flavours:
+
+  * **fast** (default): XLA's native collectives — ``psum``/``pmax``/
+    ``pmin`` pick topology-optimal algorithms (bidirectional rings on TPU);
+  * **deterministic**: :func:`tree_allreduce` replays the canonical
+    binomial-tree combination order defined by
+    :mod:`mpi_tpu.collectives_generic` (lower-rank partial on the left,
+    recursive halving then a broadcast down-sweep). Same pairing, same
+    operand order, same IEEE arithmetic → bitwise-identical results to the
+    TCP oracle (the BASELINE.json north-star requirement), at the cost of
+    ``2*ceil(log2 n)`` ppermute rounds instead of one fused ring.
+
+All functions take the mesh-axis *name*; they must be called inside
+``shard_map``/``pmap`` tracing over that axis (the standard JAX collective
+contract).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import RANK_AXIS
+
+__all__ = [
+    "OPS",
+    "allreduce",
+    "tree_allreduce",
+    "reduce_scatter",
+    "allgather",
+    "bcast",
+    "alltoall",
+    "pshift",
+]
+
+OPS = ("sum", "prod", "min", "max")
+
+
+def _combine(a: jnp.ndarray, b: jnp.ndarray, op: str) -> jnp.ndarray:
+    if op == "sum":
+        return a + b
+    if op == "prod":
+        return a * b
+    if op == "min":
+        return jnp.minimum(a, b)
+    if op == "max":
+        return jnp.maximum(a, b)
+    raise ValueError(f"mpi_tpu: unknown reduction op {op!r}; expected {OPS}")
+
+
+def allreduce(x: jnp.ndarray, axis_name: str = RANK_AXIS, op: str = "sum",
+              deterministic: bool = False) -> jnp.ndarray:
+    """Combine ``x`` across the axis; result replicated on every rank.
+
+    Fast path: XLA-native (ring) collectives. ``prod`` has no native XLA
+    collective, so it gathers and reduces in rank order (deterministic by
+    construction). ``deterministic=True`` routes through
+    :func:`tree_allreduce` for bitwise parity with the TCP driver."""
+    if deterministic:
+        return tree_allreduce(x, axis_name, op)
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "prod":
+        return jnp.prod(lax.all_gather(x, axis_name, axis=0), axis=0)
+    raise ValueError(f"mpi_tpu: unknown reduction op {op!r}; expected {OPS}")
+
+
+def tree_allreduce(x: jnp.ndarray, axis_name: str = RANK_AXIS,
+                   op: str = "sum") -> jnp.ndarray:
+    """Binomial-tree allreduce in the canonical combination order.
+
+    Up-sweep: in round ``k`` (distance ``d = 2**k``) every rank ``r`` with
+    ``r % 2d == d`` ships its partial to ``r - d``, which combines
+    ``acc = op(acc_low, acc_high)``. Down-sweep: the total walks the same
+    tree in reverse from rank 0. The mask-and-``where`` construction keeps
+    the program SPMD (identical on every rank) as XLA requires; the
+    sequenced ``ppermute`` rounds prevent any reassociation, which is what
+    pins the float result bit-for-bit to
+    ``collectives_generic.reduce``'s tree."""
+    if op not in OPS:
+        raise ValueError(f"mpi_tpu: unknown reduction op {op!r}; expected {OPS}")
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+
+    # Up-sweep (reduce to rank 0 in canonical order).
+    d = 1
+    while d < n:
+        senders = [r for r in range(n) if r % (2 * d) == d]
+        perm = [(r, r - d) for r in senders]
+        received = lax.ppermute(x, axis_name, perm)
+        is_receiver = (idx % (2 * d) == 0) & (idx + d < n)
+        x = jnp.where(is_receiver, _combine(x, received, op), x)
+        d *= 2
+
+    # Down-sweep (broadcast rank 0's total along the reversed tree).
+    distances = []
+    d = 1
+    while d < n:
+        distances.append(d)
+        d *= 2
+    for d in reversed(distances):
+        perm = [(r, r + d) for r in range(n)
+                if r % (2 * d) == 0 and r + d < n]
+        received = lax.ppermute(x, axis_name, perm)
+        is_receiver = idx % (2 * d) == d
+        x = jnp.where(is_receiver, received, x)
+    return x
+
+
+def reduce_scatter(x: jnp.ndarray, axis_name: str = RANK_AXIS,
+                   op: str = "sum", scatter_dimension: int = 0,
+                   tiled: bool = True) -> jnp.ndarray:
+    """Reduce across the axis and leave each rank with its shard —
+    the building block of bandwidth-optimal ring allreduce
+    (reduce_scatter + allgather), exposed directly because model code
+    (e.g. ZeRO-style optimizers) wants the scattered form."""
+    if op != "sum":
+        gathered = lax.all_gather(x, axis_name, axis=0)  # (n, ...)
+        acc = gathered[0]
+        n = gathered.shape[0]
+        for i in range(1, n):  # rank order — deterministic
+            acc = _combine(acc, gathered[i], op)
+        # take this rank's shard
+        idx = lax.axis_index(axis_name)
+        shard = acc.shape[scatter_dimension] // n
+        return lax.dynamic_slice_in_dim(acc, idx * shard, shard,
+                                        axis=scatter_dimension)
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def allgather(x: jnp.ndarray, axis_name: str = RANK_AXIS,
+              axis: int = 0, tiled: bool = False) -> jnp.ndarray:
+    """Every rank receives every rank's ``x``, concatenated in rank order
+    (new leading axis by default, like the facade's list-of-payloads)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def bcast(x: jnp.ndarray, root: int = 0,
+          axis_name: str = RANK_AXIS) -> jnp.ndarray:
+    """Every rank receives rank ``root``'s ``x``.
+
+    Implemented as all_gather + static index: XLA turns the gather of a
+    single used slice into an efficient broadcast, and ``root`` is almost
+    always a trace-time constant in SPMD code."""
+    return lax.all_gather(x, axis_name, axis=0)[root]
+
+
+def alltoall(x: jnp.ndarray, axis_name: str = RANK_AXIS,
+             split_axis: int = 0, concat_axis: int = 0) -> jnp.ndarray:
+    """Personalized all-to-all: split ``x`` along ``split_axis`` into
+    axis-size chunks, chunk ``j`` goes to rank ``j``; received chunks
+    concatenate along ``concat_axis`` in rank order. Lowers to XLA
+    AllToAll — the sequence-parallel (DeepSpeed-Ulysses style) primitive."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def pshift(x: jnp.ndarray, shift: int = 1,
+           axis_name: str = RANK_AXIS) -> jnp.ndarray:
+    """Ring shift: every rank sends ``x`` to ``(rank + shift) % n`` and
+    receives from ``(rank - shift) % n`` — one neighbour hop on the ICI
+    ring. The static-pattern tpu realization of Send/Receive pairs
+    (network.go:518-625) and the building block of ring attention."""
+    n = lax.axis_size(axis_name)
+    perm = [(r, (r + shift) % n) for r in range(n)]
+    return lax.ppermute(x, axis_name, perm)

@@ -1,0 +1,79 @@
+"""Device-mesh construction and topology discovery.
+
+The tpu-native analogue of the reference's rank/address bookkeeping
+(network.go:94-118): where the reference derives ranks by sorting TCP
+addresses, here a rank is a coordinate on a :class:`jax.sharding.Mesh`
+axis, and "bootstrap" is mesh construction — XLA already knows the slice
+topology, so there is no handshake to run.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+RANK_AXIS = "rank"
+
+
+def rank_axis() -> str:
+    """Canonical mesh-axis name for MPI-style rank parallelism."""
+    return RANK_AXIS
+
+
+def mesh_devices(n: Optional[int] = None) -> List[jax.Device]:
+    """First ``n`` devices in XLA enumeration order (which follows the
+    physical ICI topology on TPU slices, keeping ring neighbours adjacent).
+    ``None`` → all devices."""
+    devs = jax.devices()
+    if n is None:
+        return list(devs)
+    if n > len(devs):
+        raise ValueError(
+            f"mpi_tpu: requested {n} devices but only {len(devs)} present")
+    return list(devs[:n])
+
+
+def make_mesh(n: Optional[int] = None, axis: str = RANK_AXIS,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1-D mesh whose single axis is the MPI rank dimension.
+
+    The reference's rank↔process mapping (mpi.go:26-30) becomes
+    rank↔mesh-coordinate; ``Size()`` is the axis length."""
+    if devices is None:
+        devices = mesh_devices(n)
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def make_mesh_2d(shape: Tuple[int, int],
+                 axes: Tuple[str, str] = ("outer", "inner"),
+                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 2-D mesh for hierarchical collectives (ICI group x DCN group) —
+    used by the hierarchical allreduce (BASELINE.json config 5)."""
+    import numpy as np
+
+    n = shape[0] * shape[1]
+    if devices is None:
+        devices = mesh_devices(n)
+    return Mesh(np.asarray(devices).reshape(shape), axes)
+
+
+def describe_topology() -> dict:
+    """Human/launcher-facing topology summary (the analogue of the SLURM
+    launcher's node discovery, slurm.go:38-78, for TPU slices)."""
+    devs = jax.devices()
+    info = {
+        "platform": devs[0].platform if devs else "none",
+        "num_devices": len(devs),
+        "num_processes": jax.process_count(),
+        "process_index": jax.process_index(),
+        "local_devices": len(jax.local_devices()),
+        "device_kinds": sorted({d.device_kind for d in devs}),
+    }
+    coords = getattr(devs[0], "coords", None) if devs else None
+    if coords is not None:
+        info["coords"] = [tuple(d.coords) for d in devs]
+    return info

@@ -1,0 +1,64 @@
+"""Backend-selecting program entry — ``mpi_tpu.run_main``.
+
+The reference selects a backend by calling ``mpi.Register`` in code
+(mpi.go:61-67); everything else (addresses, timeouts) arrives via flags so
+the same binary runs anywhere. ``run_main`` extends that flag surface with
+backend selection so one program runs unmodified on either driver —
+the "examples run unmodified on a v4-8" requirement (BASELINE.json):
+
+    python prog.py --mpi-addr :6000 --mpi-alladdr :6000,:6001   # TCP ranks
+    python prog.py --mpi-backend xla --mpi-ranks 8              # mesh ranks
+
+``--mpi-backend`` (env ``MPI_TPU_BACKEND``): ``tcp`` (default) or ``xla``.
+``--mpi-ranks``   (env ``MPI_TPU_RANKS``): rank count for the xla driver
+(default: every visible device).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Optional, Sequence
+
+from . import api
+
+__all__ = ["run_main", "selected_backend"]
+
+FLAG_BACKEND = "mpi-backend"
+FLAG_RANKS = "mpi-ranks"
+ENV_BACKEND = "MPI_TPU_BACKEND"
+ENV_RANKS = "MPI_TPU_RANKS"
+
+
+def _scan_runner_flags(argv: Optional[Sequence[str]]) -> dict:
+    from .flags import scan_argv
+
+    return scan_argv({FLAG_BACKEND, FLAG_RANKS}, argv)
+
+
+def selected_backend(argv: Optional[Sequence[str]] = None) -> str:
+    found = _scan_runner_flags(argv)
+    choice = (found.get(FLAG_BACKEND) or os.environ.get(ENV_BACKEND)
+              or "tcp").lower()
+    if choice not in ("tcp", "xla"):
+        raise api.MpiError(
+            f"mpi_tpu: unknown --{FLAG_BACKEND} {choice!r} (tcp or xla)")
+    return choice
+
+
+def run_main(main: Callable[[], Any],
+             argv: Optional[Sequence[str]] = None) -> List[Any]:
+    """Run a reference-style program under the configured backend.
+
+    ``tcp``: this process is one rank; ``main()`` runs once (the launcher
+    started N processes). ``xla``: this process hosts *all* ranks;
+    ``main()`` runs SPMD, one thread per mesh device. Returns the per-rank
+    results (single-element list under tcp)."""
+    backend = selected_backend(argv)
+    if backend == "xla":
+        from .backends.xla import run_spmd
+
+        ranks_s = (_scan_runner_flags(argv).get(FLAG_RANKS)
+                   or os.environ.get(ENV_RANKS))
+        n = int(ranks_s) if ranks_s else None
+        return run_spmd(main, n=n)
+    return [main()]

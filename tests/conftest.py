@@ -20,6 +20,21 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# 64-bit payload parity with the TCP/numpy oracle (float64/int64 must not
+# silently downcast in the XLA driver).
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+# Some environments pre-import jax from sitecustomize (e.g. a TPU PJRT
+# plugin registered at interpreter startup), which latches platform/x64
+# config before this file runs — override through jax.config as well.
+import sys
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
 
 _port_lock = threading.Lock()
 

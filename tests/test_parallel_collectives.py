@@ -1,0 +1,164 @@
+"""Functional (jittable) collectives on the 8-virtual-device CPU mesh —
+exactly the code path a v4-8 runs, minus the ICI (SURVEY.md §4 rebuild
+strategy)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_tpu.parallel import collectives as C
+from mpi_tpu.parallel import make_mesh
+
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= N, "conftest must force 8 cpu devices"
+    return make_mesh(N)
+
+
+def shmap(mesh, fn, in_specs=P("rank"), out_specs=P("rank")):
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+
+def per_rank_inputs(shape=(4,), dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal(shape).astype(dtype) for _ in range(N)]
+
+
+def to_global(mesh, parts):
+    stacked = np.stack(parts)
+    return jax.device_put(stacked, NamedSharding(mesh, P("rank")))
+
+
+class TestAllreduce:
+    @pytest.mark.parametrize("op,reducer", [
+        ("sum", np.add.reduce), ("prod", np.multiply.reduce),
+        ("min", np.minimum.reduce), ("max", np.maximum.reduce)])
+    def test_fast_ops(self, mesh, op, reducer):
+        parts = per_rank_inputs((2, 3), np.float64)
+        g = to_global(mesh, parts)
+        out = shmap(mesh, lambda x: C.allreduce(x, "rank", op=op))(g)
+        expect = reducer(np.stack(parts))
+        for r in range(N):
+            np.testing.assert_allclose(np.asarray(out)[r], expect, rtol=1e-12)
+
+    def test_tree_matches_canonical_numpy_tree(self, mesh):
+        # The bitwise contract: XLA tree == the generic layer's tree.
+        parts = per_rank_inputs((257,), np.float32, seed=3)
+        g = to_global(mesh, parts)
+        out = shmap(mesh,
+                    lambda x: C.tree_allreduce(x, "rank", op="sum"))(g)
+        acc = {r: parts[r].copy() for r in range(N)}
+        d = 1
+        while d < N:
+            for r in range(0, N, 2 * d):
+                if r + d < N:
+                    acc[r] = acc[r] + acc[r + d]
+            d *= 2
+        expect = acc[0]
+        for r in range(N):
+            assert np.asarray(out)[r].tobytes() == expect.tobytes(), \
+                f"rank {r} not bitwise-identical"
+
+    @pytest.mark.parametrize("op", ["prod", "min", "max"])
+    def test_tree_other_ops(self, mesh, op):
+        parts = per_rank_inputs((16,), np.float64, seed=9)
+        g = to_global(mesh, parts)
+        out = shmap(mesh,
+                    lambda x: C.tree_allreduce(x, "rank", op=op))(g)
+        reducer = {"prod": np.multiply.reduce, "min": np.minimum.reduce,
+                   "max": np.maximum.reduce}[op]
+        expect = reducer(np.stack(parts))
+        for r in range(N):
+            np.testing.assert_allclose(np.asarray(out)[r], expect, rtol=1e-12)
+
+    def test_bad_op_raises(self, mesh):
+        g = to_global(mesh, per_rank_inputs())
+        with pytest.raises(ValueError, match="unknown reduction op"):
+            shmap(mesh, lambda x: C.allreduce(x, "rank", op="xor"))(g)
+
+
+class TestOtherCollectives:
+    def test_reduce_scatter_sum(self, mesh):
+        parts = per_rank_inputs((N * 2,), np.float32)
+        g = to_global(mesh, parts)
+        out = shmap(mesh,
+                    lambda x: C.reduce_scatter(x[0], "rank"))(g)
+        total = np.add.reduce(np.stack(parts))
+        got = np.asarray(out).reshape(N, 2)
+        for r in range(N):
+            np.testing.assert_allclose(got[r], total[2 * r: 2 * r + 2],
+                                       rtol=1e-6)
+
+    def test_allgather(self, mesh):
+        parts = per_rank_inputs((3,), np.int32)
+        g = to_global(mesh, parts)
+        out = shmap(mesh,
+                    lambda x: C.allgather(x[0], "rank")[None],
+                    out_specs=P("rank"))(g)
+        # Every rank's block is the full rank-ordered stack.
+        full = np.stack(parts)
+        arr = np.asarray(out)  # (N, N, 3)
+        for r in range(N):
+            np.testing.assert_array_equal(arr[r], full)
+
+    @pytest.mark.parametrize("root", [0, 3, 7])
+    def test_bcast(self, mesh, root):
+        parts = per_rank_inputs((5,), np.float32)
+        g = to_global(mesh, parts)
+        out = shmap(mesh,
+                    lambda x: C.bcast(x[0], root=root)[None])(g)
+        arr = np.asarray(out)
+        for r in range(N):
+            np.testing.assert_array_equal(arr[r], parts[root])
+
+    def test_alltoall(self, mesh):
+        # Rank r sends row j of its block to rank j.
+        parts = [np.arange(N, dtype=np.float32) + 100 * r for r in range(N)]
+        g = to_global(mesh, parts)
+        out = shmap(mesh,
+                    lambda x: C.alltoall(x[0][:, None], "rank").T)(g)
+        arr = np.asarray(out)  # (N, N): row r = what rank r received
+        for r in range(N):
+            np.testing.assert_array_equal(
+                arr[r], np.asarray([100 * s + r for s in range(N)],
+                                   dtype=np.float32))
+
+    def test_pshift_ring(self, mesh):
+        parts = [np.full((2,), float(r), np.float32) for r in range(N)]
+        g = to_global(mesh, parts)
+        out = shmap(mesh, lambda x: C.pshift(x, shift=1))(g)
+        arr = np.asarray(out)
+        for r in range(N):
+            np.testing.assert_array_equal(arr[r],
+                                          np.full((2,), float((r - 1) % N)))
+
+
+class TestJitProperties:
+    def test_collectives_trace_once_inside_jit(self, mesh):
+        # Everything must be traceable (no python control flow on traced
+        # values) — compile once, run twice with different data.
+        fn = shmap(mesh, lambda x: C.allreduce(x, "rank"))
+        a = to_global(mesh, per_rank_inputs(seed=1))
+        b = to_global(mesh, per_rank_inputs(seed=2))
+        fn(a)
+        out = fn(b)
+        assert np.asarray(out).shape == (N, 4)
+
+    def test_grad_through_allreduce(self, mesh):
+        # psum is differentiable — the DP-training property.
+        def loss(x):
+            y = C.allreduce(x, "rank")
+            return jnp.sum(y * y).astype(jnp.float32)
+
+        g = to_global(mesh, per_rank_inputs((4,), np.float32))
+        grad_fn = shmap(mesh, jax.grad(loss))
+        out = grad_fn(g)
+        assert np.asarray(out).shape == (N, 4)

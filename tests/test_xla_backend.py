@@ -1,0 +1,318 @@
+"""XLA driver tests: thread-per-rank SPMD over the 8-device CPU mesh,
+including the north-star bitwise TCP-vs-XLA allreduce parity
+(BASELINE.json: "bitwise-identical results to the TCP backend")."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import mpi_tpu
+from mpi_tpu import api
+from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+from conftest import run_on_ranks, tcp_cluster
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    api._reset_for_testing()
+    yield
+    api._reset_for_testing()
+
+
+def spmd(fn, n=N, **kw):
+    return run_spmd(fn, n=n, **kw)
+
+
+class TestLifecycle:
+    def test_rank_size_device_binding(self):
+        def main():
+            mpi_tpu.init()
+            r, s = mpi_tpu.rank(), mpi_tpu.size()
+            dev = mpi_tpu.registered().device()
+            mpi_tpu.finalize()
+            return (r, s, dev.id)
+
+        out = spmd(main)
+        assert [o[0] for o in out] == list(range(N))
+        assert all(o[1] == N for o in out)
+        assert len({o[2] for o in out}) == N  # distinct devices
+
+    def test_unbound_thread_rejected(self):
+        net = XlaNetwork(n=4)
+        with pytest.raises(mpi_tpu.MpiError, match="no rank binding"):
+            net.rank()
+
+    def test_too_many_ranks(self):
+        with pytest.raises(mpi_tpu.MpiError, match="need"):
+            XlaNetwork(n=99)
+
+    def test_rank_error_propagates(self):
+        def main():
+            mpi_tpu.init()
+            if mpi_tpu.rank() == 3:
+                raise RuntimeError("boom on 3")
+            mpi_tpu.barrier()
+
+        with pytest.raises((RuntimeError, mpi_tpu.MpiError)):
+            spmd(main)
+
+
+class TestPointToPoint:
+    def test_ring_exchange(self):
+        def main():
+            mpi_tpu.init()
+            r, n = mpi_tpu.rank(), mpi_tpu.size()
+            right, left = (r + 1) % n, (r - 1) % n
+            got = mpi_tpu.sendrecv(np.full(4, r, np.float32), dest=right,
+                                   source=left, tag=7)
+            mpi_tpu.finalize()
+            return got
+
+        out = spmd(main)
+        for r in range(N):
+            np.testing.assert_array_equal(
+                out[r], np.full(4, (r - 1) % N, np.float32))
+
+    def test_jax_array_payload_lands_on_dest_device(self):
+        import jax
+
+        def main():
+            mpi_tpu.init()
+            net = mpi_tpu.registered()
+            r = mpi_tpu.rank()
+            if r == 0:
+                x = jax.device_put(jax.numpy.arange(8.0), net.device(0))
+                mpi_tpu.send(x, dest=5, tag=1)
+                return None
+            if r == 5:
+                got = mpi_tpu.receive(0, tag=1)
+                return (np.asarray(got), list(got.devices())[0].id,
+                        net.device(5).id)
+            return None
+
+        out = spmd(main)
+        arr, dev_id, expect_dev = out[5]
+        np.testing.assert_array_equal(arr, np.arange(8.0))
+        assert dev_id == expect_dev  # moved to receiver's device
+
+    def test_self_send(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            t = threading.Thread(
+                target=mpi_tpu.send, args=(f"me{r}", r, 3), daemon=True)
+            t.start()
+            got = mpi_tpu.receive(r, tag=3)
+            t.join(timeout=5)
+            return got
+
+        out = spmd(main)
+        assert out == [f"me{r}" for r in range(N)]
+
+    def test_value_semantics_no_aliasing(self):
+        # gob round-trip semantics: receiver must not alias sender memory.
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            if r == 0:
+                payload = np.zeros(4)
+                mpi_tpu.send(payload, dest=1, tag=2)
+                payload[:] = 999  # mutate after send returns
+                mpi_tpu.barrier()
+                return None
+            if r == 1:
+                got = mpi_tpu.receive(0, tag=2)
+                mpi_tpu.barrier()
+                return got.copy()
+            mpi_tpu.barrier()
+            return None
+
+        out = spmd(main)
+        np.testing.assert_array_equal(out[1], np.zeros(4))
+
+    def test_tag_misuse_detected(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            hit = None
+            if r == 0:
+                t = threading.Thread(target=mpi_tpu.send,
+                                     args=(b"a", 1, 9), daemon=True)
+                t.start()
+                import time
+
+                time.sleep(0.2)
+                try:
+                    mpi_tpu.send(b"b", 1, 9)
+                except mpi_tpu.TagError as exc:
+                    hit = exc
+                mpi_tpu.send(b"go", 1, 99)
+                t.join(timeout=5)
+            elif r == 1:
+                assert mpi_tpu.receive(0, 99) == b"go"
+                assert mpi_tpu.receive(0, 9) == b"a"
+            return hit is not None
+
+        out = spmd(main)
+        assert out[0] is True
+
+
+class TestCollectives:
+    def test_allreduce_array(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            return mpi_tpu.allreduce(np.full((2, 2), float(r + 1), np.float32))
+
+        out = spmd(main)
+        expect = np.full((2, 2), sum(range(1, N + 1)), np.float32)
+        for o in out:
+            np.testing.assert_array_equal(o, expect)
+
+    def test_allreduce_scalar_and_ops(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            return (float(mpi_tpu.allreduce(float(r))),
+                    float(mpi_tpu.allreduce(float(r), op="max")),
+                    float(mpi_tpu.allreduce(float(r + 1), op="prod")))
+
+        out = spmd(main)
+        import math
+
+        for o in out:
+            assert o[0] == sum(range(N))
+            assert o[1] == N - 1
+            assert o[2] == math.factorial(N)
+
+    def test_bcast_gather_scatter_alltoall(self):
+        def main():
+            mpi_tpu.init()
+            r, n = mpi_tpu.rank(), mpi_tpu.size()
+            b = mpi_tpu.bcast({"cfg": 42} if r == 2 else None, root=2)
+            g = mpi_tpu.gather(f"g{r}", root=1)
+            s = mpi_tpu.scatter([f"s->{i}" for i in range(n)]
+                                if r == 0 else None, root=0)
+            a2a = mpi_tpu.alltoall([f"{r}->{d}" for d in range(n)])
+            ag = mpi_tpu.allgather(r * 2)
+            return b, g, s, a2a, ag
+
+        out = spmd(main)
+        for r, (b, g, s, a2a, ag) in enumerate(out):
+            assert b == {"cfg": 42}
+            assert (g == [f"g{i}" for i in range(N)]) if r == 1 else g is None
+            assert s == f"s->{r}"
+            assert a2a == [f"{src}->{r}" for src in range(N)]
+            assert ag == [i * 2 for i in range(N)]
+
+    def test_reduce_root_only(self):
+        def main():
+            mpi_tpu.init()
+            return mpi_tpu.reduce(np.float32(1.0), root=4)
+
+        out = spmd(main)
+        for r, o in enumerate(out):
+            if r == 4:
+                assert float(o) == N
+            else:
+                assert o is None
+
+    def test_mixed_payload_shape_error(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            shape = (3,) if r != 5 else (4,)
+            try:
+                mpi_tpu.allreduce(np.ones(shape, np.float32))
+                return None
+            except mpi_tpu.MpiError as exc:
+                return str(exc)
+
+        out = spmd(main)
+        assert all(o is not None and "mismatch" in o for o in out)
+
+
+@pytest.mark.parametrize("nranks", [2, 3, 5, 8])
+class TestBitwiseParity:
+    """North star: xla deterministic allreduce == TCP tree, bit for bit."""
+
+    def test_allreduce_float32(self, nranks):
+        rng = np.random.default_rng(11)
+        contribs = [rng.standard_normal(513).astype(np.float32)
+                    for _ in range(nranks)]
+
+        # TCP oracle.
+        from mpi_tpu import collectives_generic as gen
+
+        with tcp_cluster(nranks) as nets:
+            tcp_out = run_on_ranks(
+                nets, lambda net, r: gen.allreduce(net, contribs[r]))
+
+        # XLA driver, deterministic tree.
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            return mpi_tpu.registered().allreduce(contribs[r],
+                                                  deterministic=True)
+
+        xla_out = run_spmd(main, n=nranks)
+
+        for r in range(nranks):
+            assert np.asarray(xla_out[r]).tobytes() == \
+                np.asarray(tcp_out[r]).tobytes(), \
+                f"rank {r}: xla and tcp allreduce differ bitwise"
+
+    def test_allreduce_float64(self, nranks):
+        rng = np.random.default_rng(13)
+        contribs = [rng.standard_normal(64) for _ in range(nranks)]
+
+        from mpi_tpu import collectives_generic as gen
+
+        with tcp_cluster(nranks) as nets:
+            tcp_out = run_on_ranks(
+                nets, lambda net, r: gen.allreduce(net, contribs[r]))
+
+        def main():
+            mpi_tpu.init()
+            return mpi_tpu.registered().allreduce(
+                contribs[mpi_tpu.rank()], deterministic=True)
+
+        xla_out = run_spmd(main, n=nranks)
+        for r in range(nranks):
+            assert np.asarray(xla_out[r]).tobytes() == \
+                np.asarray(tcp_out[r]).tobytes()
+
+
+class TestRerunability:
+    def test_run_spmd_twice_same_process(self):
+        def main():
+            mpi_tpu.init()
+            return mpi_tpu.rank()
+
+        assert spmd(main, n=2) == [0, 1]
+        assert spmd(main, n=2) == [0, 1]  # facade released between runs
+
+    def test_allreduce_list_payload_matches_generic(self):
+        def main():
+            mpi_tpu.init()
+            return mpi_tpu.allreduce([1.0, 2.0])
+
+        out = spmd(main, n=4)
+        for o in out:
+            np.testing.assert_array_equal(np.asarray(o), [4.0, 8.0])
+
+    def test_allreduce_string_payload_raises_everywhere(self):
+        def main():
+            mpi_tpu.init()
+            try:
+                mpi_tpu.allreduce("nope")
+                return None
+            except mpi_tpu.MpiError as exc:
+                return str(exc)
+
+        out = spmd(main, n=2)
+        assert all(o and "numeric" in o for o in out)
