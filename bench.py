@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Headline benchmark: the reference's bounce ping-pong on the xla driver.
+
+The reference's only perf harness is ``examples/bounce`` — an even/odd-pair
+ping-pong over its TCP transport, mean round-trip µs per message size
+(/root/reference/examples/bounce/bounce.go:37-153). This harness runs the
+same measurement (1 MB payload, 10 reps, 2 ranks) over the **xla driver**
+— ranks as mesh positions in one process, rendezvous handoff instead of
+loopback sockets — and reports the speedup against the TCP-driver baseline
+recorded in BASELINE.md (same machine class, same payload, same method).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "us", "vs_baseline": N}
+(vs_baseline > 1 means faster than the TCP baseline.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+SIZE = 1_000_000          # bytes — the 1e6 row of the bounce sweep
+REPS = 10                 # bounce.go:35
+WARMUP = 3
+TCP_BASELINE_US = 5895.4  # BASELINE.md: TCP driver, 1e6 bytes, loopback
+
+
+def bounce_xla(size: int = SIZE, reps: int = REPS) -> float:
+    """Mean round-trip µs for a `size`-byte ping-pong on the xla backend."""
+    import mpi_tpu
+    from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+    msg = os.urandom(size)
+    times: list = []
+
+    def main():
+        mpi_tpu.init()
+        r = mpi_tpu.rank()
+        for i in range(WARMUP + reps):
+            if r == 0:
+                t0 = time.perf_counter()
+                mpi_tpu.send(msg, 1, i)
+                echo = mpi_tpu.receive(source=1, tag=i)
+                dt = time.perf_counter() - t0
+                if echo != msg:
+                    raise RuntimeError("echo mismatch")
+                if i >= WARMUP:
+                    times.append(dt)
+            else:
+                got = mpi_tpu.receive(source=0, tag=i)
+                mpi_tpu.send(got, 0, i)
+        mpi_tpu.finalize()
+
+    net = XlaNetwork(n=2, oversubscribe=True)
+    run_spmd(main, net=net)
+    return 1e6 * sum(times) / len(times)
+
+
+def main() -> None:
+    # --platform cpu[:N] pins the JAX platform before any device query.
+    # Needed because env-var selection (JAX_PLATFORMS) is unreliable when a
+    # TPU PJRT plugin is pre-registered at interpreter startup; the driver
+    # runs with no flag and gets the real chip.
+    if "--platform" in sys.argv:
+        spec = sys.argv[sys.argv.index("--platform") + 1]
+        name, _, count = spec.partition(":")
+        import jax
+
+        jax.config.update("jax_platforms", name)
+        if count:
+            jax.config.update("jax_num_cpu_devices", int(count))
+    us = bounce_xla()
+    print(json.dumps({
+        "metric": "bounce_roundtrip_1MB_xla",
+        "value": round(us, 2),
+        "unit": "us",
+        "vs_baseline": round(TCP_BASELINE_US / us, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

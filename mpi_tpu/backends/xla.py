@@ -150,18 +150,33 @@ class XlaNetwork:
 
     def __init__(self, n: Optional[int] = None,
                  devices: Optional[Sequence[Any]] = None,
-                 deterministic_collectives: bool = False):
+                 deterministic_collectives: bool = False,
+                 oversubscribe: bool = False):
         jax = _jax()
         from ..parallel.mesh import make_mesh
 
         if devices is None:
             devices = jax.devices()[: n] if n is not None else jax.devices()
         if n is not None and len(devices) < n:
-            raise MpiError(
-                f"mpi_tpu: need {n} devices for {n} ranks, have {len(devices)}")
+            if oversubscribe and devices:
+                # Reference parity: N ranks on fewer cores is always legal
+                # (gompirun spawns N processes regardless of CPU count) —
+                # map ranks onto devices round-robin.
+                base = list(devices)
+                devices = [base[r % len(base)] for r in range(n)]
+            else:
+                raise MpiError(
+                    f"mpi_tpu: need {n} devices for {n} ranks, have "
+                    f"{len(devices)} (pass oversubscribe=True to share)")
         self._devices = list(devices)
         self._n = len(self._devices)
-        self._mesh = make_mesh(devices=self._devices)
+        # With oversubscribed (duplicate) devices there is no valid mesh;
+        # native collectives then run on the canonical numpy tree instead
+        # of a compiled XLA collective.
+        if len(set(self._devices)) == len(self._devices):
+            self._mesh = make_mesh(devices=self._devices)
+        else:
+            self._mesh = None
         self._tls = threading.local()
         self._init_barrier = threading.Barrier(self._n)
         self._coll = _CollectiveSession(self._n)
@@ -277,14 +292,13 @@ class XlaNetwork:
 
     # -- native collectives ---------------------------------------------------
 
-    def _global_array(self, slots: List[np.ndarray]):
-        """Stack per-rank payloads into one mesh-sharded global array
-        (shard i on device i) — the input format XLA collectives want."""
+    @staticmethod
+    def _validate_payloads(slots: List[np.ndarray]) -> None:
+        """Cross-rank shape/dtype agreement + the float64-downcast guard.
+        Enforced identically on the mesh and oversubscribed paths so a
+        program's behavior never depends on the rank/device ratio."""
         jax = _jax()
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        shape = slots[0].shape
-        dtype = slots[0].dtype
+        shape, dtype = slots[0].shape, slots[0].dtype
         for i, s in enumerate(slots):
             if s.shape != shape or s.dtype != dtype:
                 raise MpiError(
@@ -297,6 +311,14 @@ class XlaNetwork:
                 f"downcast — enable 64-bit mode (JAX_ENABLE_X64=1 or "
                 f"jax.config.update('jax_enable_x64', True)) or send "
                 f"32-bit data")
+
+    def _global_array(self, slots: List[np.ndarray]):
+        """Stack per-rank payloads into one mesh-sharded global array
+        (shard i on device i) — the input format XLA collectives want."""
+        jax = _jax()
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shape = slots[0].shape
         sharding = NamedSharding(self._mesh, P("rank"))
         shards = [
             jax.device_put(np.asarray(s)[None], d)
@@ -353,9 +375,19 @@ class XlaNetwork:
                     f"mpi_tpu: allreduce requires numeric payloads, got "
                     f"dtype {np_slots[0].dtype}")
             scalar = np_slots[0].ndim == 0
-            garr = self._global_array(np_slots)
-            out = self._collective_fn("allreduce", op, det)(garr)
-            per = self._per_rank(out)
+            self._validate_payloads(np_slots)
+            if self._mesh is None:
+                # Oversubscribed ranks share devices → no mesh; reduce on
+                # the host in the canonical binomial-tree order (always
+                # deterministic, bitwise-equal to the TCP oracle).
+                from ..collectives_generic import tree_combine
+
+                total = tree_combine(np_slots, op)
+                per = [total.copy() for _ in range(self._n)]
+            else:
+                garr = self._global_array(np_slots)
+                out = self._collective_fn("allreduce", op, det)(garr)
+                per = self._per_rank(out)
             if scalar:
                 per = [p[()] for p in per]
             return per
@@ -436,7 +468,9 @@ def run_spmd(fn: Callable[[], Any], n: Optional[int] = None,
     re-raised after all threads stop."""
     from .. import api
 
-    network = net or XlaNetwork(n=n)
+    # Explicit rank counts oversubscribe like gompirun does (N processes
+    # regardless of core count, gompirun.go:46-51).
+    network = net or XlaNetwork(n=n, oversubscribe=True)
     if register_facade:
         api.register(network)
     nranks = network.size()
@@ -480,7 +514,16 @@ def run_spmd(fn: Callable[[], Any], n: Optional[int] = None,
         _deactivate_inheritance(network)
         if register_facade:
             api._release_backend(network)
+    # Prefer the root-cause error: ranks that merely saw a broken barrier
+    # ("collective aborted") are collateral of whichever rank failed first.
+    secondary = None
     for e in errors:
-        if e is not None:
-            raise e
+        if e is None:
+            continue
+        if isinstance(e, MpiError) and "aborted" in str(e):
+            secondary = secondary or e
+            continue
+        raise e
+    if secondary is not None:
+        raise secondary
     return results

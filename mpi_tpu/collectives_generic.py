@@ -36,6 +36,7 @@ from .api import exchange as _sendrecv  # shared concurrent-exchange engine
 __all__ = [
     "COLL_TAG_BASE",
     "combine",
+    "tree_combine",
     "reduce",
     "allreduce",
     "bcast",
@@ -98,6 +99,24 @@ def combine(a: Any, b: Any, op: str) -> Any:
     return out
 
 
+
+
+def tree_combine(slots: List[Any], op: str) -> np.ndarray:
+    """Fold ``slots`` (rank-ordered payloads) in the canonical binomial-tree
+    order — the single host-side definition of the combination order that
+    ``reduce`` executes over the wire, ``parallel.collectives.
+    tree_allreduce`` replays with ppermute rounds, and the XLA driver's
+    oversubscribed path uses directly. One source of truth → bitwise
+    parity across all drivers."""
+    check_op(op)
+    acc = [np.asarray(s) for s in slots]
+    n, d = len(acc), 1
+    while d < n:
+        for r in range(0, n, 2 * d):
+            if r + d < n:
+                acc[r] = np.asarray(combine(acc[r], acc[r + d], op))
+        d *= 2
+    return acc[0]
 
 
 def reduce(impl: Interface, data: Any, root: int = 0, op: str = "sum",

@@ -1,0 +1,28 @@
+"""Model zoo — the framework's flagship SPMD showcase.
+
+The reference (btracey/mpi) contains no ML code at all (SURVEY.md §2: "no
+tensors, no models, no attention anywhere in the repo"), so everything here
+is *new* tpu-native work, not parity work: a decoder-only Transformer LM
+whose parameters, activations and optimizer states are sharded over a
+:class:`jax.sharding.Mesh` with data- (dp), tensor- (tp) and sequence-
+(sp) parallel axes, exercising the collective layer
+(:mod:`mpi_tpu.parallel`) the way real workloads do.
+"""
+
+from .transformer import (
+    TransformerConfig,
+    init_params,
+    forward,
+    param_specs,
+    make_train_step,
+    make_mesh_nd,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "forward",
+    "param_specs",
+    "make_train_step",
+    "make_mesh_nd",
+]

@@ -1,0 +1,252 @@
+"""Decoder-only Transformer LM, sharded tpu-first over a device mesh.
+
+Design (the "pick a mesh, annotate shardings, let XLA insert collectives"
+recipe):
+
+  * parameters are a plain pytree; every leaf carries a
+    :class:`jax.sharding.PartitionSpec` from :func:`param_specs` —
+    tensor-parallel (``tp``) sharding on attention heads and the FFN hidden
+    dimension (Megatron-style column/row split, so the only tp collective
+    is one psum per block, inserted by GSPMD);
+  * the batch axis is data-parallel (``dp``), the sequence axis is
+    sequence-parallel (``sp``) — activations are constrained to
+    ``P('dp', 'sp', None)`` between blocks so layernorm/FFN/elementwise
+    work runs fully sharded and only attention gathers the sequence;
+  * compute in bfloat16 on TPU (params kept float32), matmuls shaped to
+    land on the MXU (head_dim / d_ff multiples of 128 at real sizes);
+  * no data-dependent Python control flow — the whole step is one
+    ``jit``-compiled program.
+
+The reference contains no models (SURVEY.md §2); this module is the
+framework's flagship workload, exercising the collectives the way the
+reference's ``bounce`` example exercises Send/Receive
+(/root/reference/examples/bounce/bounce.go:37-153).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "forward",
+    "param_specs",
+    "make_train_step",
+    "make_mesh_nd",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq: int = 128
+    dtype: Any = jnp.float32          # compute dtype (bfloat16 on TPU)
+    param_dtype: Any = jnp.float32    # master params
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+# --------------------------------------------------------------------------
+# Parameters
+# --------------------------------------------------------------------------
+
+def _dense_init(key, shape, dtype, fan_in):
+    return (jax.random.normal(key, shape) / math.sqrt(fan_in)).astype(dtype)
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
+    """Initialise the parameter pytree (plain dicts — easy to shard,
+    checkpoint, and inspect)."""
+    pd = cfg.param_dtype
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    params: Dict[str, Any] = {
+        "embed": _dense_init(keys[0], (cfg.vocab, cfg.d_model), pd,
+                             cfg.d_model),
+        "pos": _dense_init(keys[1], (cfg.max_seq, cfg.d_model), pd,
+                           cfg.d_model),
+        "final_ln": {"scale": jnp.ones((cfg.d_model,), pd),
+                     "bias": jnp.zeros((cfg.d_model,), pd)},
+        "blocks": [],
+    }
+    for i in range(cfg.n_layers):
+        ks = jax.random.split(keys[2 + i], 6)
+        h, d, f = cfg.n_heads, cfg.d_model, cfg.d_ff
+        hd = cfg.head_dim
+        params["blocks"].append({
+            "ln1": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
+            "ln2": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
+            "wq": _dense_init(ks[0], (d, h, hd), pd, d),
+            "wk": _dense_init(ks[1], (d, h, hd), pd, d),
+            "wv": _dense_init(ks[2], (d, h, hd), pd, d),
+            "wo": _dense_init(ks[3], (h, hd, d), pd, d),
+            "w1": _dense_init(ks[4], (d, f), pd, d),
+            "w2": _dense_init(ks[5], (f, d), pd, f),
+        })
+    return params
+
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs mirroring :func:`init_params`'s tree.
+
+    Megatron-style tp split: q/k/v column-parallel over heads, wo
+    row-parallel; w1 column-, w2 row-parallel over d_ff. Everything small
+    (layernorms, biases, positional table) is replicated. The embedding is
+    vocab-sharded over tp (the logits matmul then reduces over tp)."""
+    blk = {
+        "ln1": {"scale": P(), "bias": P()},
+        "ln2": {"scale": P(), "bias": P()},
+        "wq": P(None, "tp", None),
+        "wk": P(None, "tp", None),
+        "wv": P(None, "tp", None),
+        "wo": P("tp", None, None),
+        "w1": P(None, "tp"),
+        "w2": P("tp", None),
+    }
+    return {
+        "embed": P("tp", None),
+        "pos": P(),
+        "final_ln": {"scale": P(), "bias": P()},
+        "blocks": [dict(blk) for _ in range(cfg.n_layers)],
+    }
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * scale + bias
+
+
+def _attention(x, blk, cfg: TransformerConfig):
+    """Causal multi-head attention; heads are the tp-sharded axis, so every
+    einsum below is head-batched and GSPMD keeps it local to each tp shard
+    until ``wo`` reduces back to d_model."""
+    b, s, _ = x.shape
+    q = jnp.einsum("bsd,dhk->bshk", x, blk["wq"].astype(x.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", x, blk["wk"].astype(x.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", x, blk["wv"].astype(x.dtype))
+    logits = jnp.einsum("bshk,bthk->bhst", q, k) / math.sqrt(cfg.head_dim)
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    logits = jnp.where(mask[None, None], logits, jnp.finfo(x.dtype).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    ctx = jnp.einsum("bhst,bthk->bshk", probs, v)
+    return jnp.einsum("bshk,hkd->bsd", ctx, blk["wo"].astype(x.dtype))
+
+
+def _act_constraint(x, mesh: Optional[Mesh]):
+    """Keep activations dp-sharded on batch and sp-sharded on sequence
+    between blocks; a no-op when tracing without a mesh (single chip)."""
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P("dp", "sp", None)))
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            cfg: TransformerConfig, mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens (batch, seq) int32 → logits (batch, seq, vocab)."""
+    _, s = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = x + params["pos"].astype(cfg.dtype)[:s][None]
+    x = _act_constraint(x, mesh)
+    for blk in params["blocks"]:
+        h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
+                       blk["ln1"]["bias"].astype(x.dtype))
+        x = x + _attention(h, blk, cfg)
+        x = _act_constraint(x, mesh)
+        h = _layernorm(x, blk["ln2"]["scale"].astype(x.dtype),
+                       blk["ln2"]["bias"].astype(x.dtype))
+        h = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h,
+                                   blk["w1"].astype(x.dtype)))
+        x = x + jnp.einsum("bsf,fd->bsd", h, blk["w2"].astype(x.dtype))
+        x = _act_constraint(x, mesh)
+    x = _layernorm(x, params["final_ln"]["scale"].astype(x.dtype),
+                   params["final_ln"]["bias"].astype(x.dtype))
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype))
+
+
+def loss_fn(params, tokens, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """Next-token cross-entropy (mean over all predicted positions)."""
+    logits = forward(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+# --------------------------------------------------------------------------
+# Training step
+# --------------------------------------------------------------------------
+
+def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
+                    learning_rate: float = 1e-3):
+    """Build (init_state, step). ``step(state, tokens) -> (state, loss)``
+    is one fully jitted optimizer step; with a mesh, params/opt-state are
+    committed to :func:`param_specs` shardings and the batch to
+    ``P('dp', 'sp')`` so GSPMD inserts the dp grad-psum and tp reductions."""
+    import optax
+
+    opt = optax.adamw(learning_rate)
+
+    def init_state(key: jax.Array):
+        params = init_params(key, cfg)
+        if mesh is not None:
+            specs = param_specs(cfg)
+            params = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                params, jax.tree.unflatten(
+                    jax.tree.structure(params),
+                    jax.tree.leaves(specs, is_leaf=lambda s: isinstance(
+                        s, P))))
+            opt_state = jax.jit(opt.init)(params)
+        else:
+            opt_state = opt.init(params)
+        return {"params": params, "opt": opt_state}
+
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], tokens, cfg, mesh)
+        updates, new_opt = opt.update(grads, state["opt"], state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return {"params": new_params, "opt": new_opt}, loss
+
+    return init_state, jax.jit(step)
+
+
+def make_mesh_nd(n_devices: int,
+                 axes: Tuple[str, ...] = ("dp", "sp", "tp"),
+                 devices=None) -> Mesh:
+    """Factor ``n_devices`` into a mesh over ``axes`` (largest factors on
+    the leftmost axes), e.g. 8 → (2, 2, 2), 4 → (2, 2, 1), 1 → (1, 1, 1)."""
+    if devices is None:
+        devices = jax.devices()[:n_devices]
+    dims = [1] * len(axes)
+    rem = n_devices
+    i = 0
+    while rem > 1:
+        # peel the smallest prime factor
+        f = next((p for p in range(2, rem + 1) if rem % p == 0), rem)
+        dims[i % len(axes)] *= f
+        rem //= f
+        i += 1
+    return Mesh(np.asarray(devices).reshape(tuple(dims)), axes)

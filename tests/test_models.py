@@ -1,0 +1,104 @@
+"""Flagship-model tests: sharded training step on the virtual 8-device mesh.
+
+The reference has no models (SURVEY.md §2) — these tests cover the *new*
+SPMD showcase: forward determinism, tp/dp/sp-sharded training parity with
+the unsharded single-device step, and the driver-contract entry points.
+"""
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_tpu.models import (
+    TransformerConfig,
+    forward,
+    init_params,
+    make_mesh_nd,
+    make_train_step,
+)
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64, max_seq=32)
+
+
+def _tokens(batch=4, seq=17, seed=1):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, CFG.vocab, (batch, seq)),
+        dtype=jnp.int32)
+
+
+def test_forward_shape_and_determinism():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    toks = _tokens()[:, :-1]
+    out1 = jax.jit(lambda p, t: forward(p, t, CFG))(params, toks)
+    out2 = jax.jit(lambda p, t: forward(p, t, CFG))(params, toks)
+    assert out1.shape == (4, 16, CFG.vocab)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_train_step_reduces_loss_single_device():
+    init_state, step = make_train_step(CFG, mesh=None, learning_rate=1e-2)
+    state = init_state(jax.random.PRNGKey(0))
+    toks = _tokens()
+    losses = []
+    for _ in range(5):
+        state, loss = step(state, toks)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_sharded_step_matches_unsharded():
+    """dp=2 x sp=2 x tp=2 sharded step computes the same loss trajectory as
+    the single-device step — the collectives GSPMD inserts are exact."""
+    mesh = make_mesh_nd(8)
+    toks = _tokens()
+
+    init_u, step_u = make_train_step(CFG, mesh=None)
+    su = init_u(jax.random.PRNGKey(0))
+    init_s, step_s = make_train_step(CFG, mesh=mesh)
+    ss = init_s(jax.random.PRNGKey(0))
+
+    for _ in range(3):
+        su, lu = step_u(su, toks)
+        ss, ls = step_s(ss, toks)
+        assert float(lu) == pytest.approx(float(ls), rel=2e-5)
+
+
+def test_sharded_params_actually_sharded():
+    mesh = make_mesh_nd(8)
+    init_s, _ = make_train_step(CFG, mesh=mesh)
+    state = init_s(jax.random.PRNGKey(0))
+    w1 = state["params"]["blocks"][0]["w1"]
+    # w1 is column-parallel over tp: 2 distinct shards along dim 1.
+    assert len({s.index for s in w1.addressable_shards}) == 2
+
+
+def test_make_mesh_nd_factoring():
+    assert tuple(make_mesh_nd(8).shape.values()) == (2, 2, 2)
+    assert tuple(make_mesh_nd(4).shape.values()) == (2, 2, 1)
+    assert tuple(make_mesh_nd(2).shape.values()) == (2, 1, 1)
+    assert tuple(make_mesh_nd(1).shape.values()) == (1, 1, 1)
+    assert tuple(make_mesh_nd(6).shape.values()) == (2, 3, 1)
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[-1] == 64
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_graft_entry_dryrun_multichip():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
