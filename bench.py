@@ -58,19 +58,19 @@ def bounce_xla(size: int = SIZE, reps: int = REPS) -> float:
     return 1e6 * sum(times) / len(times)
 
 
-def main() -> None:
-    # --platform cpu[:N] pins the JAX platform before any device query.
-    # Needed because env-var selection (JAX_PLATFORMS) is unreliable when a
-    # TPU PJRT plugin is pre-registered at interpreter startup; the driver
-    # runs with no flag and gets the real chip.
+def main() -> int:
+    # --platform cpu[:N] pins the JAX platform before any device query;
+    # the driver runs with no flag and gets the real chip.
     if "--platform" in sys.argv:
-        spec = sys.argv[sys.argv.index("--platform") + 1]
-        name, _, count = spec.partition(":")
-        import jax
+        idx = sys.argv.index("--platform")
+        if idx + 1 >= len(sys.argv):
+            print("usage: bench.py [--platform NAME[:NUM_DEVICES]]",
+                  file=sys.stderr)
+            return 2
+        name, _, count = sys.argv[idx + 1].partition(":")
+        from mpi_tpu.utils.platform import force_platform
 
-        jax.config.update("jax_platforms", name)
-        if count:
-            jax.config.update("jax_num_cpu_devices", int(count))
+        force_platform(name, int(count) if count else None)
     us = bounce_xla()
     print(json.dumps({
         "metric": "bounce_roundtrip_1MB_xla",
@@ -78,6 +78,7 @@ def main() -> None:
         "unit": "us",
         "vs_baseline": round(TCP_BASELINE_US / us, 2),
     }))
+    return 0
 
 
 if __name__ == "__main__":

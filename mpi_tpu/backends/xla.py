@@ -515,12 +515,13 @@ def run_spmd(fn: Callable[[], Any], n: Optional[int] = None,
         if register_facade:
             api._release_backend(network)
     # Prefer the root-cause error: ranks that merely saw a broken barrier
-    # ("collective aborted") are collateral of whichever rank failed first.
+    # (init or collective) are collateral of whichever rank failed first.
     secondary = None
     for e in errors:
         if e is None:
             continue
-        if isinstance(e, MpiError) and "aborted" in str(e):
+        if isinstance(e, MpiError) and \
+                isinstance(e.__cause__, threading.BrokenBarrierError):
             secondary = secondary or e
             continue
         raise e

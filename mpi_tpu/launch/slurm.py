@@ -1,0 +1,179 @@
+"""SLURM launcher — rebuild of ``gompirunslurm``
+(/root/reference/mpirun/gompirunslurm/slurm.go).
+
+Usage::
+
+    salloc -N6 -c12
+    python -m mpi_tpu.launch.slurm 12 prog [args...]
+
+The first argument is **cores per rank** (not rank count — slurm.go:7-9);
+the rank count is the number of allocated nodes. For every node parsed from
+``$SLURM_JOB_NODELIST`` the launcher runs one
+
+    srun -N 1 -n 1 -c NCORES --nodelist NODE prog args... \
+         --mpi-addr NODE:PORT --mpi-alladdr LIST
+
+with ports 5000+i (slurm.go:80-83) — the same launcher<->program flag ABI
+as the local launcher, so the same program binary works under both.
+
+Nodelist grammar (slurm.go:38-78): hostnames with optional one bracket
+group of comma-separated items, each an integer or an inclusive range —
+``node[1-4,7]`` → node1 node2 node3 node4 node7. Improvements over the
+reference, all additive:
+
+  * zero-padded indices keep their width (``node[01-03]`` → node01..node03;
+    the reference strips padding, which breaks real clusters);
+  * top-level items may be separated by commas as SLURM actually emits
+    (``a,b[1-2]``) as well as the spaces the reference splits on;
+  * ``--port-base`` and ``--timeout``/``--password`` injection options;
+  * first non-zero srun exit code is propagated (the reference discards
+    child status, slurm.go:107).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+from typing import List, Optional, Sequence
+
+from ..flags import FLAG_ADDR, FLAG_ALLADDR, FLAG_INITTIMEOUT, FLAG_PASSWORD, format_duration
+
+DEFAULT_PORT_BASE = 5000  # slurm.go:82
+
+_RANGE_RE = re.compile(r"^(\d+)-(\d+)$")
+
+
+def _split_top_level(nodelist: str) -> List[str]:
+    """Split on spaces/commas that are *outside* bracket groups."""
+    items: List[str] = []
+    buf: List[str] = []
+    depth = 0
+    for ch in nodelist:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth = max(0, depth - 1)
+        if ch in ", " and depth == 0:
+            if buf:
+                items.append("".join(buf))
+                buf = []
+            continue
+        buf.append(ch)
+    if buf:
+        items.append("".join(buf))
+    return items
+
+
+def expand_nodelist(nodelist: str) -> List[str]:
+    """Expand SLURM's compressed hostlist into individual hostnames.
+
+    ``"gpu[1-3,7] cpu1"`` → ``["gpu1", "gpu2", "gpu3", "gpu7", "cpu1"]``
+    (semantics of slurm.go:41-78, plus zero-padding preservation and
+    comma-separated top level).
+    """
+    nodes: List[str] = []
+    for item in _split_top_level(nodelist.strip()):
+        head, bracket, rest = item.partition("[")
+        if not bracket:
+            nodes.append(head)
+            continue
+        body, _, tail = rest.partition("]")
+        for part in body.split(","):
+            part = part.strip()
+            m = _RANGE_RE.match(part)
+            if m:
+                lo_s, hi_s = m.group(1), m.group(2)
+                lo, hi = int(lo_s), int(hi_s)
+                if hi < lo:
+                    raise ValueError(
+                        f"mpi_tpu: bad node range {part!r} in {item!r}")
+                width = len(lo_s) if lo_s.startswith("0") else 0
+                nodes.extend(f"{head}{i:0{width}d}{tail}"
+                             for i in range(lo, hi + 1))
+            elif part:
+                nodes.append(f"{head}{part}{tail}")
+    return [n for n in nodes if n]
+
+
+def build_srun_commands(ncores: int, prog: str, prog_args: Sequence[str],
+                        nodelist: Sequence[str],
+                        port_base: int = DEFAULT_PORT_BASE,
+                        timeout: Optional[float] = None,
+                        password: Optional[str] = None) -> List[List[str]]:
+    """Synthesize one srun command line per node (slurm.go:95-104).
+
+    Pure function so tests can check the ABI without a cluster."""
+    addrs = [f"{node}:{port_base + i}" for i, node in enumerate(nodelist)]
+    alladdr = ",".join(addrs)
+    cmds: List[List[str]] = []
+    for i, node in enumerate(nodelist):
+        prog_cmd = [sys.executable, prog] if prog.endswith(".py") else [prog]
+        cmd = ["srun", "-N", "1", "-n", "1", "-c", str(ncores),
+               "--nodelist", node] + prog_cmd + list(prog_args)
+        cmd += [f"--{FLAG_ADDR}", addrs[i], f"--{FLAG_ALLADDR}", alladdr]
+        if timeout is not None:
+            cmd += [f"--{FLAG_INITTIMEOUT}", format_duration(timeout)]
+        if password is not None:
+            cmd += [f"--{FLAG_PASSWORD}", password]
+        cmds.append(cmd)
+    return cmds
+
+
+def launch(ncores: int, prog: str, prog_args: Sequence[str],
+           nodelist: Optional[Sequence[str]] = None,
+           port_base: int = DEFAULT_PORT_BASE,
+           timeout: Optional[float] = None,
+           password: Optional[str] = None,
+           env: Optional[dict] = None) -> int:
+    """Spawn one srun per node concurrently and wait for all
+    (slurm.go:93-110). Returns the first non-zero child exit code."""
+    effective_env = os.environ if env is None else env
+    if nodelist is None:
+        raw = effective_env.get("SLURM_JOB_NODELIST", "")
+        nodelist = expand_nodelist(raw)
+    if not nodelist:
+        print("slurm launcher: SLURM_JOB_NODELIST is empty — run inside an "
+              "salloc/sbatch allocation", file=sys.stderr)
+        return 2
+    cmds = build_srun_commands(ncores, prog, prog_args, nodelist,
+                               port_base=port_base, timeout=timeout,
+                               password=password)
+    child_env = dict(effective_env)
+    procs = [subprocess.Popen(cmd, env=child_env) for cmd in cmds]
+    first_bad = 0
+    for p in procs:
+        code = p.wait()
+        if code and not first_bad:
+            first_bad = code
+    return first_bad
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="mpirun-slurm",
+        description="Launch one mpi_tpu rank per SLURM-allocated node "
+                    "(gompirunslurm parity). NCORES is cores per rank.")
+    parser.add_argument("--port-base", type=int, default=DEFAULT_PORT_BASE,
+                        help="first node's port (default 5000)")
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="init timeout in seconds injected as "
+                             "--mpi-inittimeout")
+    parser.add_argument("--password", default=None,
+                        help="shared secret injected as --mpi-password")
+    parser.add_argument("ncores", type=int, help="cores per rank (srun -c)")
+    parser.add_argument("prog", help="program to run (.py runs under python)")
+    parser.add_argument("prog_args", nargs=argparse.REMAINDER,
+                        help="arguments passed through to the program")
+    args = parser.parse_args(argv)
+    if args.ncores < 1:
+        parser.error("ncores must be >= 1")
+    return launch(args.ncores, args.prog, args.prog_args,
+                  port_base=args.port_base, timeout=args.timeout,
+                  password=args.password)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

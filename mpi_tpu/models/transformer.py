@@ -236,8 +236,10 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
 def make_mesh_nd(n_devices: int,
                  axes: Tuple[str, ...] = ("dp", "sp", "tp"),
                  devices=None) -> Mesh:
-    """Factor ``n_devices`` into a mesh over ``axes`` (largest factors on
-    the leftmost axes), e.g. 8 → (2, 2, 2), 4 → (2, 2, 1), 1 → (1, 1, 1)."""
+    """Factor ``n_devices`` into a mesh over ``axes``: smallest prime
+    factors are peeled off and dealt round-robin starting at the leftmost
+    axis, e.g. 8 → (2, 2, 2), 4 → (2, 2, 1), 6 → (2, 3, 1), 12 → (2, 2, 3),
+    1 → (1, 1, 1)."""
     if devices is None:
         devices = jax.devices()[:n_devices]
     dims = [1] * len(axes)

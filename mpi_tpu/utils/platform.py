@@ -1,0 +1,31 @@
+"""JAX platform selection helper shared by the driver entry points.
+
+Pinning the platform via :func:`jax.config.update` must happen before the
+first device query; env-var selection (``JAX_PLATFORMS``) alone is
+unreliable when a TPU PJRT plugin was pre-registered at interpreter
+startup. Centralized here so ``bench.py`` and ``__graft_entry__`` apply
+the identical workaround.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["force_platform"]
+
+
+def force_platform(name: str, num_cpu_devices: Optional[int] = None) -> bool:
+    """Pin the JAX platform (and optionally the virtual CPU device count).
+
+    Returns False (instead of raising) if a backend is already live —
+    then the existing devices must suffice.
+    """
+    import jax
+
+    try:
+        if num_cpu_devices is not None:
+            jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+        jax.config.update("jax_platforms", name)
+    except RuntimeError:
+        return False
+    return True

@@ -1,0 +1,114 @@
+"""SLURM launcher tests (reference: mpirun/gompirunslurm/slurm.go)."""
+
+import os
+import stat
+import sys
+from pathlib import Path
+
+from mpi_tpu.launch import slurm
+
+
+class TestExpandNodelist:
+    def test_plain_hostname(self):
+        assert slurm.expand_nodelist("node1") == ["node1"]
+
+    def test_space_separated(self):
+        # slurm.go:39 splits on spaces.
+        assert slurm.expand_nodelist("a b c") == ["a", "b", "c"]
+
+    def test_comma_separated_top_level(self):
+        # SLURM actually emits commas at top level.
+        assert slurm.expand_nodelist("a,b,c") == ["a", "b", "c"]
+
+    def test_bracket_range(self):
+        # slurm.go:56-77: node[1-4] expands inclusively.
+        assert slurm.expand_nodelist("node[1-4]") == \
+            ["node1", "node2", "node3", "node4"]
+
+    def test_bracket_mixed_range_and_single(self):
+        assert slurm.expand_nodelist("n[1-2,7]") == ["n1", "n2", "n7"]
+
+    def test_mixed_plain_and_bracket(self):
+        assert slurm.expand_nodelist("head n[1-2]") == ["head", "n1", "n2"]
+        assert slurm.expand_nodelist("head,n[1-2]") == ["head", "n1", "n2"]
+
+    def test_zero_padding_preserved(self):
+        assert slurm.expand_nodelist("n[01-03]") == ["n01", "n02", "n03"]
+
+    def test_suffix_after_bracket(self):
+        assert slurm.expand_nodelist("n[1-2]-ib") == ["n1-ib", "n2-ib"]
+
+    def test_empty(self):
+        assert slurm.expand_nodelist("") == []
+
+    def test_bad_range_raises(self):
+        import pytest
+        with pytest.raises(ValueError):
+            slurm.expand_nodelist("n[4-1]")
+
+
+class TestBuildSrunCommands:
+    def test_srun_shape_and_flag_abi(self):
+        # slurm.go:98-103: srun -N 1 -n 1 -c C --nodelist NODE prog args
+        # then -mpi-addr node:port -mpi-alladdr full list; ports 5000+i.
+        cmds = slurm.build_srun_commands(12, "prog", ["-x"],
+                                         ["n1", "n2", "n3"])
+        assert len(cmds) == 3
+        for i, cmd in enumerate(cmds):
+            assert cmd[:7] == ["srun", "-N", "1", "-n", "1", "-c", "12"]
+            assert cmd[cmd.index("--nodelist") + 1] == f"n{i + 1}"
+            assert "prog" in cmd and "-x" in cmd
+            assert cmd.index("prog") < cmd.index("-x")
+            assert cmd[cmd.index("--mpi-addr") + 1] == f"n{i + 1}:{5000 + i}"
+            assert cmd[cmd.index("--mpi-alladdr") + 1] == \
+                "n1:5000,n2:5001,n3:5002"
+
+    def test_py_prog_runs_under_python(self):
+        cmds = slurm.build_srun_commands(1, "prog.py", [], ["n1"])
+        py = cmds[0].index(sys.executable)
+        assert cmds[0][py + 1] == "prog.py"
+
+    def test_timeout_password_injection(self):
+        cmds = slurm.build_srun_commands(1, "p", [], ["n1"],
+                                         timeout=30.0, password="pw")
+        cmd = cmds[0]
+        assert cmd[cmd.index("--mpi-inittimeout") + 1] == "30s"
+        assert cmd[cmd.index("--mpi-password") + 1] == "pw"
+
+
+class TestLaunch:
+    def _fake_srun(self, tmp_path, body):
+        fake = tmp_path / "srun"
+        fake.write_text("#!/bin/sh\n" + body)
+        fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+        env = dict(os.environ)
+        env["PATH"] = f"{tmp_path}{os.pathsep}{env['PATH']}"
+        return env
+
+    def test_one_srun_per_node(self, tmp_path):
+        out = tmp_path / "calls.txt"
+        env = self._fake_srun(
+            tmp_path, f'echo "$@" >> "{out}"\n')
+        rc = slurm.launch(4, "prog", [], nodelist=["a", "b"], env=env)
+        assert rc == 0
+        calls = out.read_text().splitlines()
+        assert len(calls) == 2
+        assert any("--nodelist a" in c for c in calls)
+        assert any("--nodelist b" in c for c in calls)
+
+    def test_failure_propagates(self, tmp_path):
+        env = self._fake_srun(tmp_path, "exit 3\n")
+        rc = slurm.launch(1, "prog", [], nodelist=["a"], env=env)
+        assert rc == 3
+
+    def test_empty_nodelist_errors(self, monkeypatch):
+        monkeypatch.setenv("SLURM_JOB_NODELIST", "")
+        assert slurm.launch(1, "prog", []) == 2
+
+    def test_nodelist_from_env(self, tmp_path):
+        out = tmp_path / "calls.txt"
+        env = self._fake_srun(tmp_path, f'echo "$@" >> "{out}"\n')
+        env["SLURM_JOB_NODELIST"] = "n[1-2]"
+        rc = slurm.launch(2, "prog", [], env=env)
+        assert rc == 0
+        assert len(out.read_text().splitlines()) == 2
