@@ -70,7 +70,10 @@ def main() -> int:
         name, _, count = sys.argv[idx + 1].partition(":")
         from mpi_tpu.utils.platform import force_platform
 
-        force_platform(name, int(count) if count else None)
+        if not force_platform(name, int(count) if count else None):
+            raise RuntimeError(
+                f"--platform {name} requested but a JAX backend is already "
+                f"initialized on another platform")
     us = bounce_xla()
     print(json.dumps({
         "metric": "bounce_roundtrip_1MB_xla",

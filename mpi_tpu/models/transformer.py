@@ -55,6 +55,10 @@ class TransformerConfig:
     max_seq: int = 128
     dtype: Any = jnp.float32          # compute dtype (bfloat16 on TPU)
     param_dtype: Any = jnp.float32    # master params
+    # "dense" | "flash" (Pallas kernel, mpi_tpu.ops) | "blockwise"
+    # (checkpointed scan) | "ring" (sequence-parallel over the sp axis,
+    # mpi_tpu.parallel.ring_attention — requires a mesh).
+    attention_impl: str = "dense"
 
     @property
     def head_dim(self) -> int:
@@ -136,19 +140,39 @@ def _layernorm(x, scale, bias, eps=1e-5):
     return (x - mu) * lax.rsqrt(var + eps) * scale + bias
 
 
-def _attention(x, blk, cfg: TransformerConfig):
+def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     """Causal multi-head attention; heads are the tp-sharded axis, so every
     einsum below is head-batched and GSPMD keeps it local to each tp shard
-    until ``wo`` reduces back to d_model."""
+    until ``wo`` reduces back to d_model. The score/value kernel is
+    selected by ``cfg.attention_impl`` (see :mod:`mpi_tpu.ops`)."""
     b, s, _ = x.shape
     q = jnp.einsum("bsd,dhk->bshk", x, blk["wq"].astype(x.dtype))
     k = jnp.einsum("bsd,dhk->bshk", x, blk["wk"].astype(x.dtype))
     v = jnp.einsum("bsd,dhk->bshk", x, blk["wv"].astype(x.dtype))
-    logits = jnp.einsum("bshk,bthk->bhst", q, k) / math.sqrt(cfg.head_dim)
-    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-    logits = jnp.where(mask[None, None], logits, jnp.finfo(x.dtype).min)
-    probs = jax.nn.softmax(logits, axis=-1)
-    ctx = jnp.einsum("bhst,bthk->bshk", probs, v)
+    impl = cfg.attention_impl
+    if impl == "flash":
+        from ..ops import flash_attention
+
+        ctx = flash_attention(q, k, v)
+    elif impl == "blockwise":
+        from ..ops import blockwise_attention
+
+        ctx = blockwise_attention(q, k, v)
+    elif impl == "ring":
+        from ..parallel.ring_attention import ring_attention_sharded
+
+        if mesh is None:
+            raise ValueError(
+                "attention_impl='ring' needs a mesh with an 'sp' axis")
+        ctx = ring_attention_sharded(q, k, v, mesh, axis_name="sp")
+    elif impl == "dense":
+        from ..ops import dense_attention
+
+        ctx = dense_attention(q, k, v)
+    else:
+        raise ValueError(
+            f"unknown attention_impl {impl!r}: expected dense|flash|"
+            f"blockwise|ring")
     return jnp.einsum("bshk,hkd->bsd", ctx, blk["wo"].astype(x.dtype))
 
 
@@ -171,7 +195,7 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
     for blk in params["blocks"]:
         h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
                        blk["ln1"]["bias"].astype(x.dtype))
-        x = x + _attention(h, blk, cfg)
+        x = x + _attention(h, blk, cfg, mesh)
         x = _act_constraint(x, mesh)
         h = _layernorm(x, blk["ln2"]["scale"].astype(x.dtype),
                        blk["ln2"]["bias"].astype(x.dtype))

@@ -1,0 +1,20 @@
+"""Hot-op kernels (Pallas on TPU, interpreter fallback elsewhere).
+
+The reference has no compute ops at all (SURVEY.md §2 — it is a pure
+communication runtime); this package is the rebuild's tpu-native ops
+library, supplying the kernels the flagship workloads sit on. Kernels are
+written with ``jax.experimental.pallas`` against the TPU backend and run
+in interpreter mode on CPU so the whole suite is testable without chips.
+"""
+
+from .attention import (
+    blockwise_attention,
+    dense_attention,
+    flash_attention,
+)
+
+__all__ = [
+    "dense_attention",
+    "blockwise_attention",
+    "flash_attention",
+]

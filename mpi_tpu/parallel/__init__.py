@@ -12,19 +12,24 @@ from .collectives import (
     allreduce,
     alltoall,
     bcast,
+    hierarchical_allreduce,
     pshift,
     reduce_scatter,
     tree_allreduce,
 )
+from .ring_attention import ring_attention, ring_attention_sharded
 
 __all__ = [
     "make_mesh",
     "mesh_devices",
     "rank_axis",
+    "ring_attention",
+    "ring_attention_sharded",
     "allgather",
     "allreduce",
     "alltoall",
     "bcast",
+    "hierarchical_allreduce",
     "pshift",
     "reduce_scatter",
     "tree_allreduce",

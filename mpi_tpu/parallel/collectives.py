@@ -38,6 +38,7 @@ __all__ = [
     "OPS",
     "allreduce",
     "tree_allreduce",
+    "hierarchical_allreduce",
     "reduce_scatter",
     "allgather",
     "bcast",
@@ -121,6 +122,33 @@ def tree_allreduce(x: jnp.ndarray, axis_name: str = RANK_AXIS,
         is_receiver = idx % (2 * d) == d
         x = jnp.where(is_receiver, received, x)
     return x
+
+
+def hierarchical_allreduce(x: jnp.ndarray, inner_axis: str = "inner",
+                           outer_axis: str = "outer",
+                           op: str = "sum") -> jnp.ndarray:
+    """Two-level allreduce for hierarchical interconnects (BASELINE.json
+    config 5: 32 ranks = ICI groups joined by a slower tier).
+
+    Bandwidth-optimal composition: **reduce-scatter over the fast inner
+    axis** (each inner rank ends up owning 1/n_inner of the buffer),
+    **allreduce the shards over the slow outer axis** (cross-group traffic
+    shrinks by n_inner×), then **allgather over the inner axis**. This is
+    the standard multi-tier trick: the slow tier moves ``bytes/n_inner``
+    instead of ``bytes``.
+
+    Requires ``x.shape[0] % inner_size == 0`` for the scatter; otherwise
+    (or for non-sum ops) it falls back to composed per-axis allreduces,
+    which are correct for any shape and op. Call inside
+    ``shard_map``/``pmap`` tracing over *both* axes (a 2-D mesh, e.g.
+    :func:`mpi_tpu.parallel.mesh.make_mesh_2d`)."""
+    ni = lax.axis_size(inner_axis)
+    if op == "sum" and x.ndim >= 1 and x.shape[0] % ni == 0:
+        shard = lax.psum_scatter(x, inner_axis, scatter_dimension=0,
+                                 tiled=True)
+        shard = lax.psum(shard, outer_axis)
+        return lax.all_gather(shard, inner_axis, axis=0, tiled=True)
+    return allreduce(allreduce(x, inner_axis, op=op), outer_axis, op=op)
 
 
 def reduce_scatter(x: jnp.ndarray, axis_name: str = RANK_AXIS,

@@ -1,0 +1,112 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Long-context is first-class in this framework: a sequence too long for one
+chip's HBM is sharded across the ``sp`` mesh axis, and attention runs as a
+**ring**: each device keeps its resident query shard and passes its
+key/value shard around the ICI ring with ``lax.ppermute``, folding one
+visiting chunk per step into flash-attention ``(m, l, acc)`` online-softmax
+state. After ``sp`` steps every query has seen every key, peak memory is
+O(seq/sp) per device, and each hop is a neighbour transfer that overlaps
+with the chunk's compute under XLA's async collectives.
+
+The reference repo has nothing like this (it is a transport library —
+SURVEY.md §5 "long-context: not applicable"); ring attention is the
+rebuild's showcase of the same ICI neighbour-transfer pattern its
+Send/Receive would express, fused into a compiled program.
+
+Two entry points:
+
+  * :func:`ring_attention` — call *inside* ``shard_map``/``pmap`` tracing
+    over the sequence axis; per-device shards shaped
+    ``(batch, seq_local, heads, head_dim)``;
+  * :func:`ring_attention_sharded` — wrapper that applies ``shard_map``
+    over a :class:`jax.sharding.Mesh` for use under plain ``jit`` (this is
+    what ``TransformerConfig(attention_impl="ring")`` uses).
+
+Causality uses *contiguous* sequence sharding: the shard on mesh position
+``i`` holds global positions ``[i*seq_local, (i+1)*seq_local)``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import NEG_INF, online_softmax_fold
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp", causal: bool = True) -> jax.Array:
+    """Per-device body: ring-rotate k/v over ``axis_name``.
+
+    Must be traced over ``axis_name`` (inside shard_map/pmap). ``q, k, v``
+    are this device's shards, ``(batch, seq_local, heads, head_dim)``.
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    perm = [(r, (r + 1) % n) for r in range(n)]
+
+    # (b, s, h, d) -> (b, h, s, d)
+    q32 = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+    kc = k.transpose(0, 2, 1, 3)
+    vc = v.transpose(0, 2, 1, 3)
+
+    m = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_local), jnp.float32)
+    acc = jnp.zeros((b, h, s_local, d), jnp.float32)
+    q_off = me * s_local
+
+    for step in range(n):
+        # After `step` rotations the resident chunk originated at me - step.
+        src = (me - step) % n
+        k_off = src * s_local
+        if causal:
+            row = q_off + lax.broadcasted_iota(
+                jnp.int32, (s_local, s_local), 0)
+            col = k_off + lax.broadcasted_iota(
+                jnp.int32, (s_local, s_local), 1)
+            mask = row >= col
+        else:
+            mask = None
+        m, l, acc = online_softmax_fold(q32, kc, vc, m, l, acc, scale,
+                                        mask=mask)
+        if step + 1 < n:
+            # Neighbour hop on the ICI ring; kv moves, queries stay.
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh, axis_name: str = "sp",
+                           causal: bool = True,
+                           batch_axis: Optional[str] = "dp",
+                           head_axis: Optional[str] = "tp") -> jax.Array:
+    """shard_map wrapper: global ``(b, s, h, d)`` arrays in, ring over the
+    sequence axis, global arrays out. Batch/head axes shard over
+    ``dp``/``tp`` when the mesh has them (pass None to replicate)."""
+    names = mesh.axis_names
+    spec = P(batch_axis if batch_axis in names else None,
+             axis_name if axis_name in names else None,
+             head_axis if head_axis in names else None,
+             None)
+    if axis_name not in names:
+        raise ValueError(
+            f"mesh {names} has no {axis_name!r} axis for ring attention")
+    body = functools.partial(ring_attention, axis_name=axis_name,
+                             causal=causal)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)

@@ -162,3 +162,64 @@ class TestJitProperties:
         grad_fn = shmap(mesh, jax.grad(loss))
         out = grad_fn(g)
         assert np.asarray(out).shape == (N, 4)
+
+
+class TestHierarchicalAllreduce:
+    """BASELINE.json config 5: two-level (ICI-group x cross-group) reduce."""
+
+    @pytest.mark.parametrize("shape2d", [(2, 4), (4, 2)])
+    def test_matches_flat_sum(self, shape2d):
+        from mpi_tpu.parallel.mesh import make_mesh_2d
+
+        mesh2 = make_mesh_2d(shape2d)
+        parts = per_rank_inputs((4, 3), np.float32)
+        want = np.add.reduce(parts)
+        spec = P(("outer", "inner"))
+        fn = jax.jit(jax.shard_map(
+            lambda x: C.hierarchical_allreduce(x),
+            mesh=mesh2, in_specs=spec, out_specs=spec, check_vma=False))
+        glob = jax.device_put(
+            np.concatenate(parts),
+            NamedSharding(mesh2, spec))
+        got = fn(glob)
+        # every rank's shard of the (replicated-then-resharded) result
+        # equals its slice of the global sum broadcast
+        np.testing.assert_allclose(
+            np.asarray(got), np.concatenate([want] * N), rtol=1e-5)
+
+    @pytest.mark.parametrize("op", ["max", "min", "prod"])
+    def test_fallback_ops(self, op):
+        from mpi_tpu.parallel.mesh import make_mesh_2d
+
+        mesh2 = make_mesh_2d((2, 4))
+        parts = per_rank_inputs((3,), np.float64, seed=3)
+        reducer = {"max": np.maximum.reduce, "min": np.minimum.reduce,
+                   "prod": np.multiply.reduce}[op]
+        want = reducer(parts)
+        spec = P(("outer", "inner"))
+        fn = jax.jit(jax.shard_map(
+            lambda x: C.hierarchical_allreduce(x, op=op),
+            mesh=mesh2, in_specs=spec, out_specs=spec, check_vma=False))
+        glob = jax.device_put(
+            np.concatenate(parts), NamedSharding(mesh2, spec))
+        got = fn(glob)
+        np.testing.assert_allclose(
+            np.asarray(got), np.concatenate([want] * N), rtol=1e-12)
+
+    def test_non_divisible_shape_falls_back(self):
+        from mpi_tpu.parallel.mesh import make_mesh_2d
+
+        mesh2 = make_mesh_2d((2, 4))
+        # per-rank shard of 1 row: shard.shape[0]=1 not divisible by
+        # inner=4 -> composed per-axis allreduce path
+        parts = per_rank_inputs((1, 5), np.float32, seed=4)
+        want = np.add.reduce(parts)
+        spec = P(("outer", "inner"))
+        fn = jax.jit(jax.shard_map(
+            lambda x: C.hierarchical_allreduce(x),
+            mesh=mesh2, in_specs=spec, out_specs=spec, check_vma=False))
+        glob = jax.device_put(
+            np.concatenate(parts), NamedSharding(mesh2, spec))
+        got = fn(glob)
+        np.testing.assert_allclose(
+            np.asarray(got), np.concatenate([want] * N), rtol=1e-5)
