@@ -187,14 +187,17 @@ def init() -> None:
 
 
 def finalize() -> None:
-    """Tear down the network (mpi.go:102-104)."""
+    """Tear down the network (mpi.go:102-104).
+
+    Delegates on *every* call: backends whose ranks are threads (xla,
+    hybrid) refcount internally so one rank finishing early cannot tear
+    the transport down under its siblings; the facade's own refcount only
+    gates ``_require_init``."""
     global _init_count
     impl = registered()
     with _lock:
         _init_count = max(0, _init_count - 1)
-        last = _init_count == 0
-    if last:
-        impl.finalize()
+    impl.finalize()
 
 
 def rank() -> int:

@@ -456,39 +456,38 @@ class XlaNetwork:
         return result if self._myrank() == root else None
 
 
-def run_spmd(fn: Callable[[], Any], n: Optional[int] = None,
-             net: Optional[XlaNetwork] = None,
-             register_facade: bool = True) -> List[Any]:
-    """Run ``fn`` SPMD: one thread per rank, each bound to a mesh device —
-    the in-process analogue of ``gompirun N prog`` (gompirun.go:28-93).
-
-    ``fn`` is reference-style user code: it calls ``mpi_tpu.init()``,
-    branches on ``mpi_tpu.rank()``, communicates, ``mpi_tpu.finalize()``.
-    Returns the per-rank return values. The first rank exception is
-    re-raised after all threads stop."""
+def drive_rank_threads(fn: Callable[[], Any], *, nranks: int,
+                       bind: Callable[[int], None],
+                       abort: Callable[[], None],
+                       inherit_net: "XlaNetwork",
+                       facade_net: Any,
+                       name_prefix: str = "mpi-rank",
+                       register_facade: bool = True,
+                       on_failure: Optional[Callable[[], None]] = None
+                       ) -> List[Any]:
+    """Shared thread-per-rank driver used by ``run_spmd`` (xla) and
+    ``run_spmd_hybrid``: spawn, bind, join with a bounded grace period
+    once any rank errors, release the facade, and re-raise the root-cause
+    error (broken-barrier collateral is reported only if nothing else
+    failed)."""
     from .. import api
 
-    # Explicit rank counts oversubscribe like gompirun does (N processes
-    # regardless of core count, gompirun.go:46-51).
-    network = net or XlaNetwork(n=n, oversubscribe=True)
     if register_facade:
-        api.register(network)
-    nranks = network.size()
+        api.register(facade_net)
     results: List[Any] = [None] * nranks
     errors: List[Optional[BaseException]] = [None] * nranks
-    _activate_inheritance(network)
+    _activate_inheritance(inherit_net)
 
     def runner(r: int) -> None:
-        network.bind_rank(r)
+        bind(r)
         try:
             results[r] = fn()
         except BaseException as exc:  # noqa: BLE001 - aggregated below
             errors[r] = exc
-            network._init_barrier.abort()
-            network._coll._barrier.abort()
+            abort()
 
     threads = [threading.Thread(target=runner, args=(r,),
-                                name=f"mpi-rank-{r}", daemon=True)
+                                name=f"{name_prefix}-{r}", daemon=True)
                for r in range(nranks)]
     for t in threads:
         t.start()
@@ -511,9 +510,11 @@ def run_spmd(fn: Callable[[], Any], n: Optional[int] = None,
             for t in alive:
                 t.join(timeout=0.1)
     finally:
-        _deactivate_inheritance(network)
+        _deactivate_inheritance(inherit_net)
         if register_facade:
-            api._release_backend(network)
+            api._release_backend(facade_net)
+        if on_failure is not None and any(e is not None for e in errors):
+            on_failure()
     # Prefer the root-cause error: ranks that merely saw a broken barrier
     # (init or collective) are collateral of whichever rank failed first.
     secondary = None
@@ -528,3 +529,27 @@ def run_spmd(fn: Callable[[], Any], n: Optional[int] = None,
     if secondary is not None:
         raise secondary
     return results
+
+
+def run_spmd(fn: Callable[[], Any], n: Optional[int] = None,
+             net: Optional[XlaNetwork] = None,
+             register_facade: bool = True) -> List[Any]:
+    """Run ``fn`` SPMD: one thread per rank, each bound to a mesh device —
+    the in-process analogue of ``gompirun N prog`` (gompirun.go:28-93).
+
+    ``fn`` is reference-style user code: it calls ``mpi_tpu.init()``,
+    branches on ``mpi_tpu.rank()``, communicates, ``mpi_tpu.finalize()``.
+    Returns the per-rank return values. The first rank exception is
+    re-raised after all threads stop."""
+    # Explicit rank counts oversubscribe like gompirun does (N processes
+    # regardless of core count, gompirun.go:46-51).
+    network = net or XlaNetwork(n=n, oversubscribe=True)
+
+    def abort() -> None:
+        network._init_barrier.abort()
+        network._coll._barrier.abort()
+
+    return drive_rank_threads(
+        fn, nranks=network.size(), bind=network.bind_rank, abort=abort,
+        inherit_net=network, facade_net=network,
+        register_facade=register_facade)

@@ -8,10 +8,14 @@ the "examples run unmodified on a v4-8" requirement (BASELINE.json):
 
     python prog.py --mpi-addr :6000 --mpi-alladdr :6000,:6001   # TCP ranks
     python prog.py --mpi-backend xla --mpi-ranks 8              # mesh ranks
+    python prog.py --mpi-backend hybrid --mpi-ranks 4 \
+        --mpi-addr :6000 --mpi-alladdr :6000,:6001   # 2 hosts x 4 locals
 
-``--mpi-backend`` (env ``MPI_TPU_BACKEND``): ``tcp`` (default) or ``xla``.
-``--mpi-ranks``   (env ``MPI_TPU_RANKS``): rank count for the xla driver
-(default: every visible device).
+``--mpi-backend`` (env ``MPI_TPU_BACKEND``): ``tcp`` (default), ``xla``,
+or ``hybrid`` (xla ranks within this host + TCP between hosts; the TCP
+flags address the *host*, ``--mpi-ranks`` counts this host's local ranks).
+``--mpi-ranks`` (env ``MPI_TPU_RANKS``): rank count for the xla/hybrid
+drivers (default: every visible device).
 """
 
 from __future__ import annotations
@@ -39,9 +43,10 @@ def selected_backend(argv: Optional[Sequence[str]] = None) -> str:
     found = _scan_runner_flags(argv)
     choice = (found.get(FLAG_BACKEND) or os.environ.get(ENV_BACKEND)
               or "tcp").lower()
-    if choice not in ("tcp", "xla"):
+    if choice not in ("tcp", "xla", "hybrid"):
         raise api.MpiError(
-            f"mpi_tpu: unknown --{FLAG_BACKEND} {choice!r} (tcp or xla)")
+            f"mpi_tpu: unknown --{FLAG_BACKEND} {choice!r} "
+            f"(tcp, xla, or hybrid)")
     return choice
 
 
@@ -54,11 +59,27 @@ def run_main(main: Callable[[], Any],
     ``main()`` runs SPMD, one thread per mesh device. Returns the per-rank
     results (single-element list under tcp)."""
     backend = selected_backend(argv)
+
+    def ranks() -> Optional[int]:
+        ranks_s = (_scan_runner_flags(argv).get(FLAG_RANKS)
+                   or os.environ.get(ENV_RANKS))
+        if not ranks_s:
+            return None
+        try:
+            return int(ranks_s)
+        except ValueError as exc:
+            raise api.MpiError(
+                f"mpi_tpu: --{FLAG_RANKS} must be an integer, "
+                f"got {ranks_s!r}") from exc
+
     if backend == "xla":
         from .backends.xla import run_spmd
 
-        ranks_s = (_scan_runner_flags(argv).get(FLAG_RANKS)
-                   or os.environ.get(ENV_RANKS))
-        n = int(ranks_s) if ranks_s else None
-        return run_spmd(main, n=n)
+        return run_spmd(main, n=ranks())
+    if backend == "hybrid":
+        from .backends.hybrid import HybridNetwork, run_spmd_hybrid
+
+        # TCP identity (addr/alladdr/timeout/password) comes from the
+        # -mpi-* flags, exactly like the tcp driver (flags.go:44-50).
+        return run_spmd_hybrid(main, HybridNetwork(local_ranks=ranks()))
     return [main()]
