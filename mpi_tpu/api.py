@@ -210,12 +210,28 @@ def size() -> int:
     return _require_init().size()
 
 
+def _payload_bytes(data: Any) -> int:
+    """Best-effort payload size for comm accounting (tracing only)."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        return len(data)
+    nbytes = getattr(data, "nbytes", None)
+    return int(nbytes) if isinstance(nbytes, int) else 0
+
+
 def send(data: Any, dest: int, tag: int) -> None:
     """Blocking rendezvous send (mpi.go:126-128): returns only once rank
     ``dest`` has accepted the message (network.go:569,617-624)."""
     impl = _require_init()
     _check_peer(dest, impl)
-    impl.send(data, dest, tag)
+    from .utils import trace
+
+    if not trace.enabled():
+        return impl.send(data, dest, tag)
+    nbytes = _payload_bytes(data)
+    trace.count("comm.send.calls")
+    trace.count("comm.send.bytes", nbytes)
+    with trace.span("mpi.send", dest=dest, tag=tag, bytes=nbytes):
+        impl.send(data, dest, tag)
 
 
 def receive(source: int, tag: int, out: Optional[Any] = None) -> Any:
@@ -226,7 +242,15 @@ def receive(source: int, tag: int, out: Optional[Any] = None) -> Any:
     reuse semantics (mpi.go:84-90)."""
     impl = _require_init()
     _check_peer(source, impl)
-    return impl.receive(source, tag, out=out)
+    from .utils import trace
+
+    if not trace.enabled():
+        return impl.receive(source, tag, out=out)
+    with trace.span("mpi.receive", source=source, tag=tag):
+        result = impl.receive(source, tag, out=out)
+    trace.count("comm.receive.calls")
+    trace.count("comm.receive.bytes", _payload_bytes(result))
+    return result
 
 
 def exchange(impl: Interface, data: Any, dest: int, source: int, tag: int,
@@ -275,7 +299,20 @@ def sendrecv(data: Any, dest: int, source: int, tag: int,
     impl = _require_init()
     _check_peer(dest, impl)
     _check_peer(source, impl)
-    return exchange(impl, data, dest, source, tag, out=out)
+    from .utils import trace
+
+    if not trace.enabled():
+        return exchange(impl, data, dest, source, tag, out=out)
+    # Count the exchange's two legs at this level — the internal engine
+    # (`exchange`) is also used by collectives_generic, whose traffic is
+    # accounted under its own collective name instead.
+    trace.count("comm.send.calls")
+    trace.count("comm.send.bytes", _payload_bytes(data))
+    trace.count("comm.receive.calls")
+    with trace.span("mpi.sendrecv", dest=dest, source=source, tag=tag):
+        result = exchange(impl, data, dest, source, tag, out=out)
+    trace.count("comm.receive.bytes", _payload_bytes(result))
+    return result
 
 
 def _check_peer(peer: int, impl: Interface) -> None:
@@ -293,10 +330,21 @@ def _collective(name: str, *args: Any, **kwargs: Any) -> Any:
     impl = _require_init()
     native = getattr(impl, name, None)
     if native is not None:
-        return native(*args, **kwargs)
-    from . import collectives_generic as gen
+        call = lambda: native(*args, **kwargs)  # noqa: E731
+    else:
+        from . import collectives_generic as gen
 
-    return getattr(gen, name)(impl, *args, **kwargs)
+        generic = getattr(gen, name)
+        call = lambda: generic(impl, *args, **kwargs)  # noqa: E731
+    from .utils import trace
+
+    if not trace.enabled():
+        return call()
+    trace.count(f"comm.{name}.calls")
+    if args:
+        trace.count(f"comm.{name}.bytes", _payload_bytes(args[0]))
+    with trace.span(f"mpi.{name}"):
+        return call()
 
 
 def allreduce(data: Any, op: str = "sum") -> Any:

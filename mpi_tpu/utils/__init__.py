@@ -1,5 +1,15 @@
-"""Utility layer: wire codec, structured logging."""
+"""Utility layer: wire codec, platform pinning, tracing, checkpointing."""
 
 from .serialize import CodecError, Raw, decode, encode
+from . import trace
+from .checkpoint import (
+    all_steps,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
 
-__all__ = ["CodecError", "Raw", "decode", "encode"]
+__all__ = [
+    "CodecError", "Raw", "decode", "encode", "trace",
+    "save_checkpoint", "restore_checkpoint", "latest_step", "all_steps",
+]

@@ -1,0 +1,173 @@
+"""Checkpoint / resume for sharded training state.
+
+The reference has no persistence at all (SURVEY.md §5 "checkpoint/resume:
+absent entirely"); this is new tpu-native work supporting the flagship
+training workloads: save any pytree of (possibly sharded) jax/numpy arrays
+to a step-numbered directory and restore it — onto the same shardings —
+later or elsewhere.
+
+Format: one ``step_N/`` directory per checkpoint containing
+
+  * ``arrays.npz``   — every array leaf, key = flattened tree path;
+  * ``meta.json``    — step number, leaf order, scalar/aux metadata.
+
+Writes are atomic (temp dir + rename), so a crash mid-save never corrupts
+the latest complete checkpoint. Sharded arrays are gathered to host before
+writing (fine for single-controller meshes — every shard is addressable);
+on restore, pass ``shardings`` (a matching pytree of
+:class:`jax.sharding.NamedSharding` / PartitionSpec-applied shardings) to
+place leaves directly back onto the mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_step",
+    "all_steps",
+]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _flatten(state: Any):
+    import jax
+
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(state)
+    keys = ["/".join(str(k) for k in path) for path, _ in leaves_with_path]
+    leaves = [leaf for _, leaf in leaves_with_path]
+    return keys, leaves, treedef
+
+
+def save_checkpoint(directory: str, state: Any, step: int,
+                    max_to_keep: Optional[int] = None) -> str:
+    """Write ``state`` (pytree of arrays/scalars) as ``step_{step}``.
+    Returns the checkpoint path. ``max_to_keep`` prunes oldest steps."""
+    import jax
+
+    os.makedirs(directory, exist_ok=True)
+    keys, leaves, _ = _flatten(state)
+    arrays: Dict[str, np.ndarray] = {}
+    for key, leaf in zip(keys, leaves):
+        # Sharded device arrays gather to host; everything numeric becomes
+        # an ndarray (0-d for scalars) so the npz round-trip is lossless.
+        arrays[key] = np.asarray(jax.device_get(leaf))
+    final = os.path.join(directory, f"step_{step}")
+    tmp = tempfile.mkdtemp(prefix=f".step_{step}.tmp.", dir=directory)
+    try:
+        with open(os.path.join(tmp, "arrays.npz"), "wb") as f:
+            np.savez(f, **arrays)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump({"step": step, "keys": keys,
+                       "format": "mpi_tpu.checkpoint.v1"}, f)
+        # Overwrite atomically: park the old step under a temp name before
+        # the rename so a crash in between leaves either the old or the
+        # new checkpoint complete, never neither.
+        old = None
+        if os.path.exists(final):
+            old = tempfile.mkdtemp(prefix=f".step_{step}.old.",
+                                   dir=directory)
+            os.rmdir(old)
+            os.rename(final, old)
+        os.rename(tmp, final)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    if max_to_keep is not None:
+        steps = all_steps(directory)
+        for old in steps[:-max_to_keep]:
+            shutil.rmtree(os.path.join(directory, f"step_{old}"),
+                          ignore_errors=True)
+    return final
+
+
+def all_steps(directory: str) -> List[int]:
+    """Complete checkpoint steps present, ascending."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        m = _STEP_RE.match(name)
+        if m and os.path.exists(os.path.join(directory, name, "meta.json")):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    steps = all_steps(directory)
+    return steps[-1] if steps else None
+
+
+def restore_checkpoint(directory: str, template: Any,
+                       step: Optional[int] = None,
+                       shardings: Optional[Any] = None) -> Any:
+    """Load ``step`` (default: latest) into the structure of ``template``.
+
+    ``template`` supplies the tree structure and leaf dtypes/kinds (its
+    array *values* are ignored). ``shardings``, if given, is a matching
+    pytree whose leaves are shardings (or None for host placement); each
+    restored leaf is ``jax.device_put`` onto its sharding — the restore
+    path for tp/dp-sharded train state.
+    """
+    import jax
+
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(
+                f"mpi_tpu: no checkpoints under {directory!r}")
+    path = os.path.join(directory, f"step_{step}")
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    with np.load(os.path.join(path, "arrays.npz")) as npz:
+        arrays = {k: npz[k] for k in npz.files}
+
+    keys, leaves, treedef = _flatten(template)
+    if sorted(keys) != sorted(meta["keys"]):
+        missing = set(meta["keys"]) - set(keys)
+        extra = set(keys) - set(meta["keys"])
+        raise ValueError(
+            f"mpi_tpu: checkpoint/template tree mismatch "
+            f"(missing from template: {sorted(missing)[:5]}, "
+            f"not in checkpoint: {sorted(extra)[:5]})")
+
+    shard_leaves: List[Any] = [None] * len(leaves)
+    if shardings is not None:
+        s_keys, s_leaves, _ = _flatten(shardings)
+        by_key = dict(zip(s_keys, s_leaves))
+        shard_leaves = [by_key.get(k) for k in keys]
+
+    out_leaves = []
+    for key, tmpl, shard in zip(keys, leaves, shard_leaves):
+        val = arrays[key]
+        if isinstance(tmpl, (int, float, bool, complex)) and val.ndim == 0:
+            out_leaves.append(type(tmpl)(val[()]))
+            continue
+        if shard is not None:
+            out_leaves.append(jax.device_put(val, shard))
+        elif isinstance(tmpl, jax.Array):
+            from jax.sharding import NamedSharding
+
+            sh = getattr(tmpl, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                out_leaves.append(jax.device_put(val, sh))
+            else:
+                # Single-device jit outputs (e.g. optimizer step counters)
+                # must stay *uncommitted* so the next jitted step can place
+                # them beside mesh-sharded leaves without a device clash.
+                out_leaves.append(jax.numpy.asarray(val))
+        else:
+            out_leaves.append(val)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)

@@ -1,0 +1,177 @@
+"""Tracing / profiling — spans, comm counters, and jax.profiler hooks.
+
+The reference has no tracing subsystem at all — its only instrument is the
+bounce example's manual ``time.Now()`` deltas (SURVEY.md §5; bounce.go:
+90-101). This module supplies the idiomatic tpu equivalents:
+
+  * **spans** — wall-clock regions (``with span("allreduce", bytes=n)``)
+    recorded into a bounded process-local buffer (events beyond the cap
+    are dropped and counted — see :func:`dropped`) and exportable as a
+    chrome://tracing / Perfetto JSON trace (``dump_chrome_trace``);
+  * **counters** — monotonically accumulated values (bytes sent/received
+    per peer, collective invocations), queryable for bench harnesses;
+  * **device profiling** — :func:`profile` wraps ``jax.profiler.trace``
+    so a region's XLA/TPU activity lands in TensorBoard-compatible
+    traces alongside the host spans.
+
+Off by default and cheap when off (one attribute check per call site);
+enable with ``MPI_TPU_TRACE=1`` or :func:`enable`. The facade
+(:mod:`mpi_tpu.api`) instruments send/receive/collectives through this
+module, so any backend gets comm accounting for free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional
+
+__all__ = [
+    "enable",
+    "disable",
+    "enabled",
+    "span",
+    "count",
+    "counters",
+    "events",
+    "dropped",
+    "clear",
+    "dump_chrome_trace",
+    "profile",
+]
+
+_MAX_EVENTS = 100_000
+
+
+class _Tracer:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.events: List[Dict[str, Any]] = []
+        self.counters: Dict[str, float] = {}
+        self.enabled = bool(os.environ.get("MPI_TPU_TRACE"))
+        self.dropped = 0
+
+    def add_event(self, ev: Dict[str, Any]) -> None:
+        with self.lock:
+            if len(self.events) >= _MAX_EVENTS:
+                self.dropped += 1
+                return
+            self.events.append(ev)
+
+    def add_count(self, name: str, value: float) -> None:
+        with self.lock:
+            self.counters[name] = self.counters.get(name, 0) + value
+
+
+_tracer = _Tracer()
+
+
+def enable() -> None:
+    """Turn span/counter recording on for this process."""
+    _tracer.enabled = True
+
+
+def disable() -> None:
+    _tracer.enabled = False
+
+
+def enabled() -> bool:
+    return _tracer.enabled
+
+
+@contextmanager
+def span(name: str, **attrs: Any) -> Iterator[None]:
+    """Record a wall-clock region. No-op (one bool check) when disabled."""
+    if not _tracer.enabled:
+        yield
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter_ns()
+        _tracer.add_event({
+            "name": name,
+            "ts_us": t0 / 1e3,
+            "dur_us": (t1 - t0) / 1e3,
+            "thread": threading.current_thread().name,
+            **attrs,
+        })
+
+
+def count(name: str, value: float = 1) -> None:
+    """Accumulate a counter (e.g. ``comm.send.bytes``). No-op when
+    disabled."""
+    if _tracer.enabled:
+        _tracer.add_count(name, value)
+
+
+def counters() -> Dict[str, float]:
+    with _tracer.lock:
+        return dict(_tracer.counters)
+
+
+def events() -> List[Dict[str, Any]]:
+    with _tracer.lock:
+        return list(_tracer.events)
+
+
+def dropped() -> int:
+    """Events discarded because the buffer cap was hit."""
+    with _tracer.lock:
+        return _tracer.dropped
+
+
+def clear() -> None:
+    with _tracer.lock:
+        _tracer.events.clear()
+        _tracer.counters.clear()
+        _tracer.dropped = 0
+
+
+def dump_chrome_trace(path: str) -> int:
+    """Write recorded spans as a chrome://tracing / Perfetto JSON file.
+    Returns the number of events written."""
+    with _tracer.lock:
+        evs = list(_tracer.events)
+        cts = dict(_tracer.counters)
+        ndropped = _tracer.dropped
+    trace = {
+        "traceEvents": [
+            {
+                "name": e["name"],
+                "ph": "X",
+                "ts": e["ts_us"],
+                "dur": e["dur_us"],
+                "pid": os.getpid(),
+                "tid": e.get("thread", "main"),
+                "args": {k: v for k, v in e.items()
+                         if k not in ("name", "ts_us", "dur_us", "thread")},
+            }
+            for e in evs
+        ],
+        "metadata": {"counters": cts, "dropped_events": ndropped},
+    }
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return len(evs)
+
+
+@contextmanager
+def profile(logdir: str, host_spans: bool = True) -> Iterator[None]:
+    """Capture a jax.profiler device trace (TensorBoard format) for the
+    region, optionally enabling host span recording too."""
+    import jax
+
+    prev = _tracer.enabled
+    if host_spans:
+        _tracer.enabled = True
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        _tracer.enabled = prev

@@ -1,0 +1,169 @@
+"""Tracing spans/counters and checkpoint save/restore."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mpi_tpu
+from mpi_tpu.utils import (
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+    trace,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    trace.clear()
+    trace.disable()
+    yield
+    trace.clear()
+    trace.disable()
+
+
+class TestTrace:
+    def test_disabled_is_noop(self):
+        with trace.span("x", a=1):
+            pass
+        trace.count("c", 5)
+        assert trace.events() == []
+        assert trace.counters() == {}
+
+    def test_spans_and_counters_record(self):
+        trace.enable()
+        with trace.span("outer", size=3):
+            trace.count("bytes", 100)
+            trace.count("bytes", 50)
+        evs = trace.events()
+        assert len(evs) == 1
+        assert evs[0]["name"] == "outer" and evs[0]["size"] == 3
+        assert evs[0]["dur_us"] >= 0
+        assert trace.counters() == {"bytes": 150}
+
+    def test_chrome_dump(self, tmp_path):
+        trace.enable()
+        with trace.span("step", n=1):
+            pass
+        path = tmp_path / "trace.json"
+        n = trace.dump_chrome_trace(str(path))
+        assert n == 1
+        doc = json.loads(path.read_text())
+        (ev,) = doc["traceEvents"]
+        assert ev["name"] == "step" and ev["ph"] == "X"
+        assert ev["args"] == {"n": 1}
+
+    def test_facade_comm_accounting(self):
+        from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+        trace.enable()
+
+        def main():
+            mpi_tpu.init()
+            me = mpi_tpu.rank()
+            if me == 0:
+                mpi_tpu.send(np.zeros(8, np.float32), 1, tag=1)
+            elif me == 1:
+                mpi_tpu.receive(source=0, tag=1)
+            mpi_tpu.allreduce(np.ones((2,), np.float32))
+            mpi_tpu.finalize()
+
+        run_spmd(main, net=XlaNetwork(n=2, oversubscribe=True))
+        cts = trace.counters()
+        assert cts["comm.send.calls"] == 1
+        assert cts["comm.send.bytes"] == 32
+        assert cts["comm.receive.calls"] == 1
+        assert cts["comm.allreduce.calls"] == 2
+        names = {e["name"] for e in trace.events()}
+        assert {"mpi.send", "mpi.receive", "mpi.allreduce"} <= names
+
+
+class TestCheckpoint:
+    def _state(self, key=0):
+        k = jax.random.PRNGKey(key)
+        return {
+            "params": {"w": jax.random.normal(k, (4, 3)),
+                       "b": jnp.zeros((3,))},
+            "step": 7,
+            "lr": 1e-3,
+        }
+
+    def test_roundtrip(self, tmp_path):
+        state = self._state()
+        save_checkpoint(str(tmp_path), state, step=7)
+        assert latest_step(str(tmp_path)) == 7
+        got = restore_checkpoint(str(tmp_path), self._state(key=1))
+        np.testing.assert_array_equal(got["params"]["w"],
+                                      np.asarray(state["params"]["w"]))
+        assert got["step"] == 7 and isinstance(got["step"], int)
+        assert got["lr"] == pytest.approx(1e-3)
+
+    def test_multiple_steps_and_pruning(self, tmp_path):
+        for s in (1, 2, 3, 4):
+            save_checkpoint(str(tmp_path), self._state(), step=s,
+                            max_to_keep=2)
+        from mpi_tpu.utils import all_steps
+
+        assert all_steps(str(tmp_path)) == [3, 4]
+        got = restore_checkpoint(str(tmp_path), self._state(), step=3)
+        assert got["step"] == 7
+
+    def test_template_mismatch_raises(self, tmp_path):
+        save_checkpoint(str(tmp_path), self._state(), step=1)
+        with pytest.raises(ValueError, match="tree mismatch"):
+            restore_checkpoint(str(tmp_path), {"other": jnp.zeros(2)})
+
+    def test_restore_onto_sharded_mesh(self, tmp_path):
+        from mpi_tpu.models import (
+            TransformerConfig, init_params, param_specs, make_mesh_nd)
+
+        cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=32, max_seq=16)
+        mesh = make_mesh_nd(8)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        save_checkpoint(str(tmp_path), params, step=0)
+
+        specs = param_specs(cfg)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda s: isinstance(s, P))
+        got = restore_checkpoint(str(tmp_path),
+                                 init_params(jax.random.PRNGKey(1), cfg),
+                                 shardings=shardings)
+        # values restored...
+        np.testing.assert_array_equal(
+            np.asarray(got["embed"]), np.asarray(params["embed"]))
+        # ...and placed on the tp sharding
+        blk = got["blocks"][0]
+        assert not blk["w1"].sharding.is_fully_replicated
+        assert blk["w1"].sharding.spec == P(None, "tp")
+
+    def test_no_checkpoint_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            restore_checkpoint(str(tmp_path), {"x": 1})
+
+    def test_resume_train_state_with_opt_scalars(self, tmp_path):
+        # Regression: optimizer step counters are single-device jit
+        # outputs; restoring them *committed* to device 0 clashes with
+        # mesh-sharded params inside the next jitted step.
+        from mpi_tpu.models import (
+            TransformerConfig, make_mesh_nd, make_train_step)
+
+        cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=32, max_seq=16)
+        mesh = make_mesh_nd(8)
+        init_state, step = make_train_step(cfg, mesh=mesh)
+        state = init_state(jax.random.PRNGKey(0))
+        toks = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab, (4, 9)), jnp.int32)
+        state, l0 = step(state, toks)
+        save_checkpoint(str(tmp_path), state, step=1)
+        restored = restore_checkpoint(str(tmp_path),
+                                      init_state(jax.random.PRNGKey(1)))
+        restored, l1 = step(restored, toks)  # must not raise
+        _, l1b = step(state, toks)
+        assert float(l1) == pytest.approx(float(l1b))
