@@ -86,6 +86,34 @@ def _split_hostport(addr: str) -> Tuple[str, int]:
 
 def _send_frame(sock: socket.socket, lock: threading.Lock, kind: int,
                 tag: int, payload: bytes = b"") -> None:
+    from .. import native as _native
+
+    # Python socket timeouts make the fd non-blocking at the OS level;
+    # the native engine only speaks blocking sockets (post-handshake data
+    # path — handshake frames keep the Python path). Payloads past the
+    # u32 wire limit fall through so struct.pack rejects them loudly.
+    lib = _native.wirecore() if sock.gettimeout() is None else None
+    if lib is not None and isinstance(payload, bytes) \
+            and len(payload) <= 0xFFFFFFFF:
+        # Native path: header + payload leave in one writev — no
+        # user-space concatenation copy — with the GIL released for the
+        # whole syscall loop (ctypes CDLL semantics). -EINTR returns here
+        # so pending Python signal handlers (Ctrl+C) run between resumes.
+        import ctypes
+        import errno as _errno
+        import os as _os
+
+        progress = ctypes.c_uint64(0)
+        with lock:
+            while True:
+                rc = lib.wc_send_frame(sock.fileno(), kind, tag, payload,
+                                       len(payload),
+                                       ctypes.byref(progress))
+                if rc != -_errno.EINTR:
+                    break
+        if rc == 0:
+            return
+        raise OSError(-rc, _os.strerror(-rc))
     header = _FRAME_HDR.pack(kind, tag, len(payload))
     with lock:
         sock.sendall(header + payload)
@@ -95,7 +123,28 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     """Read exactly ``n`` bytes. Returns the freshly-owned bytearray
     (no defensive copy — the caller is the sole owner, which lets
     decode() alias large payloads zero-copy)."""
+    from .. import native as _native
+
     buf = bytearray(n)
+    lib = _native.wirecore() if sock.gettimeout() is None else None
+    if lib is not None and n:
+        import ctypes
+        import errno as _errno
+
+        arr = (ctypes.c_ubyte * n).from_buffer(buf)
+        progress = ctypes.c_uint64(0)
+        while True:
+            rc = lib.wc_recv_exact(sock.fileno(), arr, n,
+                                   ctypes.byref(progress))
+            if rc != -_errno.EINTR:
+                break
+        if rc == _native.PEER_CLOSED:
+            raise ConnectionError("connection closed by peer")
+        if rc != 0:
+            import os as _os
+
+            raise OSError(-rc, _os.strerror(-rc))
+        return buf
     view = memoryview(buf)
     got = 0
     while got < n:

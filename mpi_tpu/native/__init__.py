@@ -1,0 +1,114 @@
+"""Native runtime core — build-on-demand C++ loaded via ctypes.
+
+The reference's runtime is compiled Go; the rebuild's equivalent native
+layer lives in ``native/*.cpp`` and is compiled lazily with the system
+toolchain into a per-user cache, then loaded with :mod:`ctypes` (no
+pybind11 needed — the ABI is plain C). Everything degrades gracefully:
+if no compiler is present or ``MPI_TPU_NO_NATIVE=1`` is set, callers get
+``None`` and use their pure-Python fallbacks, with identical semantics
+(tests cover both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Optional
+
+__all__ = ["wirecore", "available", "build_error"]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_error: Optional[str] = None
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native", "wirecore.cpp")
+
+PEER_CLOSED = 1000
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "mpi_tpu")
+
+
+def _build() -> ctypes.CDLL:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out_dir = _cache_dir()
+    os.makedirs(out_dir, exist_ok=True)
+    so_path = os.path.join(out_dir, f"wirecore-{digest}.so")
+    if not os.path.exists(so_path):
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=out_dir)
+        os.close(fd)
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               _SRC, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so_path)  # atomic publish; races converge
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    lib = ctypes.CDLL(so_path)
+    lib.wc_send_frame.restype = ctypes.c_int
+    lib.wc_send_frame.argtypes = [
+        ctypes.c_int, ctypes.c_uint8, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint64)]
+    lib.wc_recv_exact.restype = ctypes.c_int
+    lib.wc_recv_exact.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.wc_version.restype = ctypes.c_int
+    if lib.wc_version() != 2:
+        raise RuntimeError("wirecore version mismatch")
+    return lib
+
+
+def wirecore() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if
+    unavailable (non-linux, no compiler, or MPI_TPU_NO_NATIVE=1)."""
+    global _lib, _tried, _error
+    if _tried:
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        if os.environ.get("MPI_TPU_NO_NATIVE") \
+                or not sys.platform.startswith("linux") \
+                or sys.byteorder != "little":
+            # The wire format is explicit little-endian; wirecore.cpp
+            # memcpys host-order ints, so big-endian hosts must not load.
+            _error = "disabled"
+        else:
+            try:
+                _lib = _build()
+            except BaseException as exc:  # noqa: BLE001 - fall back to python
+                _error = f"{type(exc).__name__}: {exc}"
+        _tried = True
+        return _lib
+
+
+def available() -> bool:
+    return wirecore() is not None
+
+
+def build_error() -> Optional[str]:
+    """Why the native core is unavailable (None if loaded or untried)."""
+    wirecore()
+    return _error
+
+
+def _reset_for_testing() -> None:
+    global _lib, _tried, _error
+    with _lock:
+        _lib, _tried, _error = None, False, None

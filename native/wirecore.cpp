@@ -1,0 +1,94 @@
+// wirecore — native frame engine for the TCP driver's hot data path.
+//
+// The reference's transport is compiled Go (network.go); this is the
+// rebuild's native runtime core: framed send/receive over blocking
+// sockets, called from Python via ctypes (which drops the GIL for the
+// duration of each call, so rank threads stream frames concurrently).
+//
+// Wire frame (matches mpi_tpu/backends/tcp.py):
+//     kind:u8  tag:i64le  length:u32le  payload[length]
+//
+// Send uses writev so the 13-byte header and an arbitrarily large payload
+// go to the kernel in one syscall without concatenating them in user
+// space (the Python fallback builds a header+payload bytes object — an
+// extra full-payload copy per frame).
+//
+// Signal cooperation: EINTR is returned to the caller (with progress
+// recorded in *progress) instead of being retried in C — returning to
+// the interpreter lets CPython run pending signal handlers (Ctrl+C)
+// exactly like the pure-Python path, after which the caller resumes the
+// same call with the same progress pointer.
+//
+// All functions return 0 on success or -errno on failure; kPeerClosed
+// means the peer closed cleanly (recv side). They never throw and never
+// touch Python state. Little-endian hosts only — the loader enforces
+// sys.byteorder == "little" (the memcpy'd tag/length below are raw host
+// order).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int kPeerClosed = 1000;
+constexpr uint64_t kHeaderLen = 13;
+
+}  // namespace
+
+extern "C" {
+
+// Send one frame: header (kind, tag, length) + payload via writev.
+// *progress counts total frame bytes already written (header included);
+// start with 0 and re-invoke unchanged after -EINTR.
+int wc_send_frame(int fd, uint8_t kind, int64_t tag, const uint8_t *payload,
+                  uint32_t length, uint64_t *progress) {
+  uint8_t header[kHeaderLen];
+  header[0] = kind;
+  std::memcpy(header + 1, &tag, 8);
+  std::memcpy(header + 9, &length, 4);
+  const uint64_t total = kHeaderLen + length;
+  while (*progress < total) {
+    uint64_t done = *progress;
+    iovec iov[2];
+    int iovcnt = 0;
+    if (done < kHeaderLen) {
+      iov[iovcnt].iov_base = header + done;
+      iov[iovcnt].iov_len = kHeaderLen - done;
+      ++iovcnt;
+      done = 0;
+    } else {
+      done -= kHeaderLen;
+    }
+    if (length > done) {
+      iov[iovcnt].iov_base = const_cast<uint8_t *>(payload + done);
+      iov[iovcnt].iov_len = length - done;
+      ++iovcnt;
+    }
+    ssize_t n = ::writev(fd, iov, iovcnt);
+    if (n < 0) return -errno;  // -EINTR resumes from *progress
+    *progress += static_cast<uint64_t>(n);
+  }
+  return 0;
+}
+
+// Receive exactly n bytes into buf. *progress counts bytes already read;
+// start with 0 and re-invoke unchanged after -EINTR.
+int wc_recv_exact(int fd, uint8_t *buf, uint64_t n, uint64_t *progress) {
+  while (*progress < n) {
+    ssize_t r = ::recv(fd, buf + *progress, n - *progress, 0);
+    if (r < 0) return -errno;  // -EINTR resumes from *progress
+    if (r == 0) return kPeerClosed;
+    *progress += static_cast<uint64_t>(r);
+  }
+  return 0;
+}
+
+// Sanity probe for the loader.
+int wc_version() { return 2; }
+
+}  // extern "C"
