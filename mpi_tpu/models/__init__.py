@@ -13,16 +13,24 @@ from .transformer import (
     TransformerConfig,
     init_params,
     forward,
+    forward_with_aux,
     param_specs,
+    sanitize_spec,
     make_train_step,
     make_mesh_nd,
 )
+from .moe import init_moe_params, moe_ffn, moe_specs
 
 __all__ = [
     "TransformerConfig",
     "init_params",
     "forward",
+    "forward_with_aux",
     "param_specs",
+    "sanitize_spec",
     "make_train_step",
     "make_mesh_nd",
+    "init_moe_params",
+    "moe_ffn",
+    "moe_specs",
 ]

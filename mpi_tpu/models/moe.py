@@ -1,0 +1,116 @@
+"""Mixture-of-Experts FFN — expert parallelism over the ``ep`` mesh axis.
+
+GShard-style top-1 routed MoE with static shapes (XLA needs them): each
+token picks its highest-probability expert, experts process fixed-capacity
+token buffers, and overflow tokens fall through the residual connection.
+Expert weights carry a leading expert axis sharded ``P('ep', ...)``; the
+dispatched token buffers are constrained to the same axis, so GSPMD
+inserts the all-to-all exchanges that carry tokens to their experts over
+ICI — the standard tpu-native MoE dataflow (no reference analogue:
+btracey/mpi has no ML code, SURVEY.md §2).
+
+Everything here is einsum/one-hot arithmetic — MXU-friendly, fully
+differentiable, no data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["init_moe_params", "moe_specs", "moe_ffn"]
+
+
+def init_moe_params(key: jax.Array, d_model: int, d_ff: int,
+                    n_experts: int, dtype: Any) -> Dict[str, Any]:
+    k1, k2, k3 = jax.random.split(key, 3)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape) / math.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "router": dense(k1, (d_model, n_experts), d_model),
+        "w1e": dense(k2, (n_experts, d_model, d_ff), d_model),
+        "w2e": dense(k3, (n_experts, d_ff, d_model), d_ff),
+    }
+
+
+def moe_specs() -> Dict[str, P]:
+    """PartitionSpecs for :func:`init_moe_params`'s tree: experts over
+    ``ep``, the FFN hidden dim over ``tp`` (Megatron split inside each
+    expert); the router is small and replicated."""
+    return {
+        "router": P(),
+        "w1e": P("ep", None, "tp"),
+        "w2e": P("ep", "tp", None),
+    }
+
+
+def moe_ffn(x: jax.Array, params: Dict[str, Any], n_experts: int,
+            capacity_factor: float = 1.25,
+            mesh: Optional[Mesh] = None) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 routed expert FFN.
+
+    ``x``: (batch, seq, d_model). Returns ``(y, aux)`` where ``y`` has
+    x's shape (overflowed tokens produce zeros — the caller's residual
+    stream carries them through) and ``aux`` is the load-balancing loss
+    (Shazeer et al.: ``E * sum_e fraction_tokens_e * mean_prob_e``,
+    minimised at uniform routing).
+
+    Tokens are routed within *groups* (one group per batch row, the
+    GShard/Switch recipe): the dispatch one-hots are (groups, seq, E, C)
+    with per-group capacity, so memory stays linear in the global token
+    count instead of quadratic, and group = batch row keeps routing
+    aligned with the dp sharding (no cross-device cumsum).
+    """
+    b, s, d = x.shape
+    e = n_experts
+    capacity = max(1, int(math.ceil(s / e * capacity_factor)))
+
+    logits = jnp.einsum("gnd,de->gne", x, params["router"].astype(x.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate = jnp.max(probs, axis=-1)                  # (G, N)
+    expert = jnp.argmax(probs, axis=-1)             # (G, N)
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.int32)  # (G, N, E)
+
+    # Position of each token within its expert's per-group buffer
+    # (exclusive int cumsum in token order — deterministic priority, and
+    # exact for any token count, unlike a float32 cumsum).
+    pos = jnp.cumsum(onehot, axis=1) - onehot       # (G, N, E)
+    pos = jnp.einsum("gne,gne->gn", pos, onehot)    # (G, N) int32
+    kept = pos < capacity
+    gate = jnp.where(kept, gate, 0.0)
+
+    # dispatch[g, n, e', c] = 1 iff token (g, n) sits in slot c of
+    # expert e''s group-g buffer.
+    dispatch = (onehot * kept[..., None]).astype(jnp.float32)[..., None] \
+        * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[..., None, :]
+    combine = dispatch * gate[..., None, None]      # (G, N, E, C)
+
+    xin = jnp.einsum("gnec,gnd->gecd", dispatch.astype(x.dtype), x)
+    buf_sharding = None
+    if mesh is not None and "ep" in mesh.axis_names:
+        from .transformer import sanitize_spec
+
+        # Commit the expert buffers to the ep axis: GSPMD materialises the
+        # token all-to-all here (tokens travel to their expert's device).
+        buf_sharding = NamedSharding(
+            mesh, sanitize_spec(P("dp", "ep", None, None), mesh))
+        xin = lax.with_sharding_constraint(xin, buf_sharding)
+    h = jax.nn.gelu(jnp.einsum("gecd,edf->gecf", xin,
+                               params["w1e"].astype(x.dtype)))
+    y_e = jnp.einsum("gecf,efd->gecd", h, params["w2e"].astype(x.dtype))
+    if buf_sharding is not None:
+        y_e = lax.with_sharding_constraint(y_e, buf_sharding)
+    y = jnp.einsum("gnec,gecd->gnd", combine.astype(x.dtype), y_e)
+
+    # Load-balance aux: fraction of tokens routed to e x mean router prob.
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=(0, 1))
+    mean_prob = jnp.mean(probs, axis=(0, 1))
+    aux = e * jnp.sum(frac * mean_prob)
+    return y, aux.astype(jnp.float32)

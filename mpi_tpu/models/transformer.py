@@ -39,7 +39,9 @@ __all__ = [
     "TransformerConfig",
     "init_params",
     "forward",
+    "forward_with_aux",
     "param_specs",
+    "sanitize_spec",
     "make_train_step",
     "make_mesh_nd",
 ]
@@ -59,6 +61,12 @@ class TransformerConfig:
     # (checkpointed scan) | "ring" (sequence-parallel over the sp axis,
     # mpi_tpu.parallel.ring_attention — requires a mesh).
     attention_impl: str = "dense"
+    # Mixture-of-Experts FFN (0 = dense). Experts shard over the 'ep'
+    # mesh axis (mpi_tpu.models.moe); aux load-balance loss is added to
+    # the training objective with coefficient moe_aux_coef.
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -92,16 +100,22 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
         ks = jax.random.split(keys[2 + i], 6)
         h, d, f = cfg.n_heads, cfg.d_model, cfg.d_ff
         hd = cfg.head_dim
-        params["blocks"].append({
+        blk = {
             "ln1": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
             "ln2": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
             "wq": _dense_init(ks[0], (d, h, hd), pd, d),
             "wk": _dense_init(ks[1], (d, h, hd), pd, d),
             "wv": _dense_init(ks[2], (d, h, hd), pd, d),
             "wo": _dense_init(ks[3], (h, hd, d), pd, d),
-            "w1": _dense_init(ks[4], (d, f), pd, d),
-            "w2": _dense_init(ks[5], (f, d), pd, f),
-        })
+        }
+        if cfg.n_experts > 0:
+            from .moe import init_moe_params
+
+            blk["moe"] = init_moe_params(ks[4], d, f, cfg.n_experts, pd)
+        else:
+            blk["w1"] = _dense_init(ks[4], (d, f), pd, d)
+            blk["w2"] = _dense_init(ks[5], (f, d), pd, f)
+        params["blocks"].append(blk)
     return params
 
 
@@ -119,9 +133,14 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
         "wk": P(None, "tp", None),
         "wv": P(None, "tp", None),
         "wo": P("tp", None, None),
-        "w1": P(None, "tp"),
-        "w2": P("tp", None),
     }
+    if cfg.n_experts > 0:
+        from .moe import moe_specs
+
+        blk["moe"] = moe_specs()
+    else:
+        blk["w1"] = P(None, "tp")
+        blk["w2"] = P("tp", None)
     return {
         "embed": P("tp", None),
         "pos": P(),
@@ -176,22 +195,58 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     return jnp.einsum("bshk,hkd->bsd", ctx, blk["wo"].astype(x.dtype))
 
 
+def sanitize_spec(spec: P, mesh: Optional[Mesh]) -> P:
+    """Drop axis names the mesh doesn't have (→ replicated) so one set of
+    canonical specs works on any mesh shape (e.g. a dp x ep MoE mesh has
+    no 'tp'; a pure-tp mesh has no 'sp')."""
+    if mesh is None:
+        return spec
+    names = set(mesh.axis_names)
+
+    def keep(p):
+        if p is None:
+            return None
+        if isinstance(p, tuple):
+            kept = tuple(q for q in p if q in names)
+            return kept if kept else None
+        return p if p in names else None
+
+    return P(*(keep(p) for p in spec))
+
+
 def _act_constraint(x, mesh: Optional[Mesh]):
     """Keep activations dp-sharded on batch and sp-sharded on sequence
     between blocks; a no-op when tracing without a mesh (single chip)."""
     if mesh is None:
         return x
     return lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P("dp", "sp", None)))
+        x, NamedSharding(mesh, sanitize_spec(P("dp", "sp", None), mesh)))
 
 
-def forward(params: Dict[str, Any], tokens: jax.Array,
-            cfg: TransformerConfig, mesh: Optional[Mesh] = None) -> jax.Array:
-    """tokens (batch, seq) int32 → logits (batch, seq, vocab)."""
+def _ffn(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh]):
+    """Position-wise FFN: Megatron-split dense (default) or top-1 routed
+    MoE over the 'ep' axis. Returns (y, aux_loss)."""
+    if cfg.n_experts > 0:
+        from .moe import moe_ffn
+
+        return moe_ffn(x, blk["moe"], cfg.n_experts,
+                       capacity_factor=cfg.capacity_factor, mesh=mesh)
+    h = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, blk["w1"].astype(x.dtype)))
+    y = jnp.einsum("bsf,fd->bsd", h, blk["w2"].astype(x.dtype))
+    return y, jnp.zeros((), jnp.float32)
+
+
+def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
+                     cfg: TransformerConfig,
+                     mesh: Optional[Mesh] = None
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """tokens (batch, seq) int32 → (logits (batch, seq, vocab), aux_loss).
+    ``aux_loss`` is the summed MoE load-balance penalty (0 for dense)."""
     _, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = x + params["pos"].astype(cfg.dtype)[:s][None]
     x = _act_constraint(x, mesh)
+    aux = jnp.zeros((), jnp.float32)
     for blk in params["blocks"]:
         h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
                        blk["ln1"]["bias"].astype(x.dtype))
@@ -199,23 +254,30 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
         x = _act_constraint(x, mesh)
         h = _layernorm(x, blk["ln2"]["scale"].astype(x.dtype),
                        blk["ln2"]["bias"].astype(x.dtype))
-        h = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h,
-                                   blk["w1"].astype(x.dtype)))
-        x = x + jnp.einsum("bsf,fd->bsd", h, blk["w2"].astype(x.dtype))
+        y, blk_aux = _ffn(h, blk, cfg, mesh)
+        aux = aux + blk_aux
+        x = x + y
         x = _act_constraint(x, mesh)
     x = _layernorm(x, params["final_ln"]["scale"].astype(x.dtype),
                    params["final_ln"]["bias"].astype(x.dtype))
-    return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype))
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype)), aux
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            cfg: TransformerConfig, mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens (batch, seq) int32 → logits (batch, seq, vocab)."""
+    return forward_with_aux(params, tokens, cfg, mesh)[0]
 
 
 def loss_fn(params, tokens, cfg: TransformerConfig,
             mesh: Optional[Mesh] = None) -> jax.Array:
-    """Next-token cross-entropy (mean over all predicted positions)."""
-    logits = forward(params, tokens[:, :-1], cfg, mesh)
+    """Next-token cross-entropy (mean over all predicted positions), plus
+    the MoE load-balance penalty when experts are enabled."""
+    logits, aux = forward_with_aux(params, tokens[:, :-1], cfg, mesh)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(nll) + cfg.moe_aux_coef * aux
 
 
 # --------------------------------------------------------------------------
@@ -237,7 +299,8 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
         if mesh is not None:
             specs = param_specs(cfg)
             params = jax.tree.map(
-                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(mesh, sanitize_spec(s, mesh))),
                 params, jax.tree.unflatten(
                     jax.tree.structure(params),
                     jax.tree.leaves(specs, is_leaf=lambda s: isinstance(

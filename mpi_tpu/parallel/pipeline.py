@@ -1,0 +1,102 @@
+"""Pipeline parallelism over a mesh axis — GPipe on collectives.
+
+Layers are sharded across the ``pp`` mesh axis (each device owns one
+*stage* — a contiguous slice of the layer stack) and microbatches stream
+through the ring: at every step each stage computes on its in-flight
+microbatch and hands the activation to the next stage with a single
+``lax.ppermute`` neighbour hop (ICI on TPU). The whole schedule is one
+``lax.scan`` inside ``shard_map`` — no host round-trips, fully
+differentiable (``ppermute``/``scan`` both have transpose rules), and
+compiled once.
+
+The reference repo has no model execution at all (SURVEY.md §2); this is
+new tpu-native work completing the framework's parallelism matrix
+(dp / sp / tp / **pp** / ep).
+
+Schedule (classic GPipe fill-drain): with ``S`` stages and ``M``
+microbatches, step ``t`` has stage ``s`` processing microbatch
+``m = t - s`` when ``0 <= m < M``; total ``M + S - 1`` steps, bubble
+fraction ``(S-1)/(M+S-1)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline", "pipeline_sharded"]
+
+
+def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
+             stage_params: Any, xs: jax.Array,
+             axis_name: str = "pp") -> jax.Array:
+    """Per-device body: stream microbatches through the stage ring.
+
+    Must be traced over ``axis_name`` (inside shard_map/pmap).
+
+    ``stage_fn(stage_params, x) -> y`` applies *this device's* stage to
+    one microbatch activation (y must have x's shape/dtype — standard for
+    transformer blocks). ``stage_params`` is this device's stage slice;
+    ``xs`` is ``(M, ...)`` microbatched input, present on stage 0
+    (replication is fine — other stages' copies are ignored).
+
+    Returns ``(M, ...)`` outputs, valid on the **last** stage and
+    broadcast to every stage for convenience.
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    m_total = xs.shape[0]
+    steps = m_total + n - 1
+
+    def step(carry, t):
+        arriving = carry  # activation handed to us by the previous stage
+        # Stage 0 feeds fresh microbatches; everyone else consumes the hop.
+        feed = lax.dynamic_index_in_dim(
+            xs, jnp.minimum(t, m_total - 1), axis=0, keepdims=False)
+        inp = jnp.where(s == 0, feed, arriving)
+        my_m = t - s  # microbatch index this stage would be working on
+        active = (my_m >= 0) & (my_m < m_total)
+        y = stage_fn(stage_params, inp)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        nxt = lax.ppermute(y, axis_name,
+                           [(i, (i + 1) % n) for i in range(n)])
+        return nxt, y
+
+    _, ys = lax.scan(step, jnp.zeros_like(xs[0]),
+                     jnp.arange(steps, dtype=jnp.int32))
+    # Last stage emits microbatch m at step m + n - 1.
+    outs = ys[n - 1:]
+    # Broadcast the last stage's outputs around the ring so every device
+    # returns the same thing (callers shouldn't care where results live).
+    from .collectives import bcast
+
+    return bcast(outs, root=n - 1, axis_name=axis_name)
+
+
+def pipeline_sharded(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                     stacked_params: Any, xs: jax.Array, mesh,
+                     axis_name: str = "pp",
+                     extra_param_spec: Optional[P] = None) -> jax.Array:
+    """shard_map wrapper: ``stacked_params`` leaves carry a leading stage
+    axis of size ``mesh.shape[axis_name]`` (stage i's slice on device i);
+    ``xs`` is the global ``(M, ...)`` microbatch stack, replicated."""
+    if axis_name not in mesh.axis_names:
+        raise ValueError(
+            f"mesh {mesh.axis_names} has no {axis_name!r} axis")
+
+    def body(params, xs_local):
+        # shard_map gives each device a (1, ...) slice; drop the axis.
+        own = jax.tree.map(lambda p: p[0], params)
+        return pipeline(stage_fn, own, xs_local, axis_name=axis_name)
+
+    pspec = extra_param_spec or P(axis_name)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: pspec, stacked_params), P()),
+        out_specs=P(),
+        check_vma=False)
+    return fn(stacked_params, xs)
