@@ -12,9 +12,19 @@ from .attention import (
     dense_attention,
     flash_attention,
 )
+from .ring_collectives import (
+    ring_allgather,
+    ring_allgather_sharded,
+    ring_allreduce,
+    ring_allreduce_sharded,
+)
 
 __all__ = [
     "dense_attention",
     "blockwise_attention",
     "flash_attention",
+    "ring_allgather",
+    "ring_allgather_sharded",
+    "ring_allreduce",
+    "ring_allreduce_sharded",
 ]

@@ -58,8 +58,8 @@ class TestMoeFfn:
         # capacity most tokens overflow and produce zeros.
         params, x = self._setup()
         params = dict(params)
-        params["router"] = jnp.zeros_like(params["router"]).at[:, 0].set(0)
-        params["router"] = params["router"].at[0, 0].add(100.0)
+        params["router"] = jnp.zeros_like(params["router"]).at[0, 0].add(
+            100.0)
         x = x.at[..., 0].set(10.0)  # strong expert-0 preference
         y, aux = moe_ffn(x, params, 4, capacity_factor=0.3)
         n_tok = x.shape[0] * x.shape[1]

@@ -1,0 +1,71 @@
+"""Pallas ring collectives (remote-DMA kernels) on the virtual mesh.
+
+These run the exact kernel code a TPU slice executes, through the Pallas
+interpreter — semaphores, double buffering, and neighbour DMA included.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi_tpu.ops.ring_collectives import (
+    ring_allgather_sharded,
+    ring_allreduce_sharded,
+)
+
+
+def _mesh(n, axis="rank"):
+    return Mesh(np.asarray(jax.devices()[:n]), (axis,))
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_ring_allgather(n):
+    mesh = _mesh(n)
+    x = jnp.arange(n * 3 * 2, dtype=jnp.float32).reshape(n * 3, 2)
+    out = ring_allgather_sharded(x, mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+@pytest.mark.parametrize("op", ["sum", "max", "min", "prod"])
+def test_ring_allreduce_ops(n, op):
+    mesh = _mesh(n)
+    rng = np.random.default_rng(0)
+    contribs = jnp.asarray(
+        rng.uniform(0.5, 1.5, (n, 8, 3)).astype(np.float32))
+    out = ring_allreduce_sharded(contribs, mesh, op=op)
+    reducer = {"sum": np.add.reduce, "max": np.maximum.reduce,
+               "min": np.minimum.reduce, "prod": np.multiply.reduce}[op]
+    np.testing.assert_allclose(np.asarray(out),
+                               reducer(np.asarray(contribs)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ring_allreduce_padding_path():
+    # m = 5 not divisible by n = 4 -> internal pad + trim
+    mesh = _mesh(4)
+    contribs = jnp.asarray(
+        np.random.default_rng(1).standard_normal((4, 5)).astype(np.float32))
+    out = ring_allreduce_sharded(contribs, mesh)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(contribs).sum(0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ring_allreduce_under_jit_with_sharded_input():
+    mesh = _mesh(4)
+    contribs = jnp.asarray(
+        np.random.default_rng(2).standard_normal((4, 8)).astype(np.float32))
+    contribs = jax.device_put(contribs, NamedSharding(mesh, P("rank")))
+    fn = jax.jit(lambda c: ring_allreduce_sharded(c, mesh))
+    np.testing.assert_allclose(np.asarray(fn(contribs)),
+                               np.asarray(contribs).sum(0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ring_size_mismatch_raises():
+    mesh = _mesh(4)
+    with pytest.raises(ValueError, match="ring"):
+        ring_allreduce_sharded(jnp.zeros((3, 4)), mesh)
