@@ -45,9 +45,8 @@ def main() -> None:
 
         force_platform("cpu", args.devices)
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
+    from mpi_tpu.data import ShardedLoader, SyntheticLM
     from mpi_tpu.models import TransformerConfig, make_mesh_nd, make_train_step
     from mpi_tpu.utils import (latest_step, restore_checkpoint,
                                save_checkpoint, trace)
@@ -72,13 +71,16 @@ def main() -> None:
             state = restore_checkpoint(args.checkpoint_dir, state)
             print(f"resumed from step {start}")
 
-    rng = np.random.default_rng(0)
-    data = jnp.asarray(rng.integers(0, cfg.vocab, (args.batch, args.seq)),
-                       dtype=jnp.int32)
+    # Deterministic, resumable, dp-sharded stream with host-side prefetch
+    # (restart at --resume replays exactly the batches it would have seen).
+    loader = iter(ShardedLoader(
+        SyntheticLM(cfg.vocab, args.batch, args.seq), mesh=mesh,
+        start_step=start))
     for i in range(start, start + args.steps):
+        tokens = next(loader)
         with trace.span("train.step", step=i):
             t0 = time.perf_counter()
-            state, loss = step(state, data)
+            state, loss = step(state, tokens)
             loss = float(loss)
             dt = time.perf_counter() - t0
         print(f"step {i:4d}  loss {loss:.4f}  {dt * 1e3:7.1f} ms")
