@@ -9,9 +9,14 @@ same measurement (1 MB payload, 10 reps, 2 ranks) over the **xla driver**
 loopback sockets — and reports the speedup against the TCP-driver baseline
 recorded in BASELINE.md (same machine class, same payload, same method).
 
-Prints ONE JSON line:
+Prints ONE JSON line on stdout:
     {"metric": ..., "value": N, "unit": "us", "vs_baseline": N}
 (vs_baseline > 1 means faster than the TCP baseline.)
+
+``--suite`` additionally runs the Allreduce bandwidth sweep
+(BASELINE.json config 3: 1 KiB → 256 MiB float32 over every visible
+device) and prints the table to **stderr**, keeping stdout's single-line
+contract intact.
 """
 
 from __future__ import annotations
@@ -58,6 +63,48 @@ def bounce_xla(size: int = SIZE, reps: int = REPS) -> float:
     return 1e6 * sum(times) / len(times)
 
 
+def allreduce_sweep(min_bytes: int = 1 << 10, max_bytes: int = 256 << 20,
+                    reps: int = 5) -> None:
+    """BASELINE.json config 3: Allreduce float32 bandwidth sweep over every
+    visible device; table to stderr (stdout keeps the one-line contract)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi_tpu.parallel import collectives as C
+    from mpi_tpu.parallel import make_mesh
+
+    n = len(jax.devices())
+    mesh = make_mesh(n)
+    fn = jax.jit(jax.shard_map(lambda x: C.allreduce(x, "rank"), mesh=mesh,
+                               in_specs=P("rank"), out_specs=P("rank"),
+                               check_vma=False))
+    print(f"# allreduce float32 sweep, {n} device(s), {reps} reps",
+          file=sys.stderr)
+    print(f"{'bytes/rank':>12}  {'p50 us':>10}  {'algbw GB/s':>10}  "
+          f"{'busbw GB/s':>10}", file=sys.stderr)
+    size = min_bytes
+    while size <= max_bytes:
+        elems = size // 4
+        # Host-built buffer: device_put with the sharding transfers
+        # shard-wise, so device 0 never holds the full global array.
+        x = jax.device_put(
+            np.ones((n, elems), np.float32),
+            NamedSharding(mesh, P("rank")))
+        fn(x).block_until_ready()  # compile + warm
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(x).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        p50 = float(np.median(times))
+        algbw = size / p50 / 1e9
+        busbw = algbw * 2 * (n - 1) / n if n > 1 else algbw
+        print(f"{size:>12}  {p50 * 1e6:>10.1f}  {algbw:>10.2f}  "
+              f"{busbw:>10.2f}", file=sys.stderr)
+        size *= 4
+
+
 def main() -> int:
     # --platform cpu[:N] pins the JAX platform before any device query;
     # the driver runs with no flag and gets the real chip.
@@ -74,6 +121,8 @@ def main() -> int:
             raise RuntimeError(
                 f"--platform {name} requested but a JAX backend is already "
                 f"initialized on another platform")
+    if "--suite" in sys.argv:
+        allreduce_sweep()
     us = bounce_xla()
     print(json.dumps({
         "metric": "bounce_roundtrip_1MB_xla",

@@ -348,11 +348,20 @@ class XlaNetwork:
                 # x: (1, *shape) block; reduce over the mesh axis.
                 return C.allreduce(x, "rank", op=op,
                                    deterministic=deterministic)
+
+            out_specs = P("rank")
+        elif kind == "allgather":
+            def per_shard(x):
+                # x: (1, *shape) block; gather the full (n, *shape) stack,
+                # replicated on every device.
+                return C.allgather(x, "rank", axis=0, tiled=True)
+
+            out_specs = P()
         else:  # pragma: no cover - future kinds
             raise MpiError(f"unknown collective kind {kind}")
 
         fn = jax.jit(jax.shard_map(per_shard, mesh=self._mesh,
-                                   in_specs=P("rank"), out_specs=P("rank"),
+                                   in_specs=P("rank"), out_specs=out_specs,
                                    check_vma=False))
         self._jit_cache[key] = fn
         return fn
@@ -420,8 +429,41 @@ class XlaNetwork:
         return self._coll.run(self._myrank(), data, leader)
 
     def allgather(self, data: Any) -> List[Any]:
+        """Array payloads of matching shape/dtype gather with ONE compiled
+        XLA all_gather over the mesh (ICI on TPU); anything else (objects,
+        ragged shapes) uses the in-process handoff. Returned entries may
+        alias between ranks, matching the generic driver's semantics."""
+
+        jax = _jax()
+
         def leader(slots: List[Any]) -> List[Any]:
-            return [list(slots) for _ in range(self._n)]
+            uniform = (
+                self._mesh is not None
+                and all(isinstance(s, (np.ndarray, jax.Array))
+                        and s.ndim >= 1 for s in slots)
+            )
+            if uniform:
+                np_slots = [np.asarray(s) for s in slots]
+                dt = np_slots[0].dtype
+                uniform = (
+                    dt.kind in "fiubc"
+                    # allgather is a pass-through, not a reduction: any
+                    # dtype XLA would canonicalize away (int64/float64/
+                    # complex128 without x64) must take the in-process
+                    # handoff, which returns payloads untouched.
+                    and jax.dtypes.canonicalize_dtype(dt) == dt
+                    and all(s.shape == np_slots[0].shape and s.dtype == dt
+                            for s in np_slots)
+                )
+            if not uniform:
+                return [list(slots) for _ in range(self._n)]
+            garr = self._global_array(np_slots)
+            out = self._collective_fn("allgather", "", False)(garr)
+            rows = np.asarray(out)
+            gathered = [rows[i] for i in range(self._n)]
+            # Fresh list per rank (elements may alias; the containers must
+            # not — same contract as the fallback path).
+            return [list(gathered) for _ in range(self._n)]
 
         return self._coll.run(self._myrank(), data, leader)
 

@@ -76,10 +76,21 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             col = k_off + lax.broadcasted_iota(
                 jnp.int32, (s_local, s_local), 1)
             mask = row >= col
+            # A chunk strictly in this device's future (src > me under the
+            # contiguous layout) is fully masked — skip both matmuls with
+            # a runtime conditional. The ppermute below still runs every
+            # step, keeping the collective schedule uniform across
+            # devices; only the local compute is elided.
+            kc_s, vc_s = kc, vc
+            m, l, acc = lax.cond(
+                k_off > q_off + s_local - 1,
+                lambda state: state,
+                lambda state: online_softmax_fold(
+                    q32, kc_s, vc_s, *state, scale, mask=mask),
+                (m, l, acc))
         else:
-            mask = None
-        m, l, acc = online_softmax_fold(q32, kc, vc, m, l, acc, scale,
-                                        mask=mask)
+            m, l, acc = online_softmax_fold(q32, kc, vc, m, l, acc, scale,
+                                            mask=None)
         if step + 1 < n:
             # Neighbour hop on the ICI ring; kv moves, queries stay.
             kc = lax.ppermute(kc, axis_name, perm)

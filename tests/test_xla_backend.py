@@ -410,3 +410,50 @@ def test_oversubscribed_validation_matches_mesh_path():
     with pytest.raises(mpi_tpu.MpiError, match="payload mismatch"):
         run_spmd(main, net=XlaNetwork(n=12, oversubscribe=True))
     api._reset_for_testing()
+
+
+class TestCompiledAllgather:
+    """Uniform array payloads take the single compiled XLA all_gather."""
+
+    def test_array_allgather_values(self):
+        def main():
+            mpi_tpu.init()
+            me = mpi_tpu.rank()
+            got = mpi_tpu.allgather(
+                np.full((2, 3), float(me), np.float32))
+            mpi_tpu.finalize()
+            return got
+
+        results = spmd(main, n=4)
+        for per_rank in results:
+            assert len(per_rank) == 4
+            for r, arr in enumerate(per_rank):
+                np.testing.assert_array_equal(
+                    np.asarray(arr), np.full((2, 3), float(r), np.float32))
+
+    def test_mixed_payloads_fall_back(self):
+        def main():
+            mpi_tpu.init()
+            me = mpi_tpu.rank()
+            payload = {"rank": me} if me % 2 else np.zeros(2, np.float32)
+            got = mpi_tpu.allgather(payload)
+            mpi_tpu.finalize()
+            return got
+
+        results = spmd(main, n=4)
+        for per_rank in results:
+            assert per_rank[1] == {"rank": 1}
+            np.testing.assert_array_equal(per_rank[0],
+                                          np.zeros(2, np.float32))
+
+    def test_scalar_payloads_keep_types(self):
+        def main():
+            mpi_tpu.init()
+            got = mpi_tpu.allgather(mpi_tpu.rank() * 10)
+            mpi_tpu.finalize()
+            return got
+
+        results = spmd(main, n=4)
+        for per_rank in results:
+            assert per_rank == [0, 10, 20, 30]
+            assert all(isinstance(v, int) for v in per_rank)
