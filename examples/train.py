@@ -38,6 +38,8 @@ def main() -> None:
     ap.add_argument("--resume", action="store_true")
     ap.add_argument("--trace", default=None,
                     help="write a chrome://tracing JSON here at exit")
+    ap.add_argument("--sample", type=int, default=0,
+                    help="after training, greedily generate N tokens")
     args, _ = ap.parse_known_args()
 
     if args.devices:
@@ -88,6 +90,17 @@ def main() -> None:
             save_checkpoint(args.checkpoint_dir, state, step=i + 1,
                             max_to_keep=3)
             print(f"checkpointed step {i + 1}")
+
+    if args.sample:
+        import numpy as np
+
+        from mpi_tpu.models import generate
+
+        prompt = ShardedLoader(
+            SyntheticLM(cfg.vocab, 1, 8, seed=99)).batch_at(0)
+        toks = generate(state["params"], prompt, cfg,
+                        max_new_tokens=args.sample)
+        print("sampled:", np.asarray(toks)[0].tolist())
 
     if args.trace:
         nev = trace.dump_chrome_trace(args.trace)

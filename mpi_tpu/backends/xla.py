@@ -445,13 +445,17 @@ class XlaNetwork:
             if uniform:
                 np_slots = [np.asarray(s) for s in slots]
                 dt = np_slots[0].dtype
+                # allgather is a pass-through, not a reduction: the only
+                # dtype gate is canonicalization — anything XLA would
+                # rewrite (int64/float64/complex128 without x64) takes the
+                # in-process handoff, which returns payloads untouched.
+                # bfloat16 (kind 'V') stays on the compiled path.
+                try:
+                    canonical = jax.dtypes.canonicalize_dtype(dt) == dt
+                except TypeError:
+                    canonical = False
                 uniform = (
-                    dt.kind in "fiubc"
-                    # allgather is a pass-through, not a reduction: any
-                    # dtype XLA would canonicalize away (int64/float64/
-                    # complex128 without x64) must take the in-process
-                    # handoff, which returns payloads untouched.
-                    and jax.dtypes.canonicalize_dtype(dt) == dt
+                    canonical
                     and all(s.shape == np_slots[0].shape and s.dtype == dt
                             for s in np_slots)
                 )

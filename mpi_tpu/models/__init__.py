@@ -20,6 +20,7 @@ from .transformer import (
     make_mesh_nd,
 )
 from .moe import init_moe_params, moe_ffn, moe_specs
+from .generate import decode_step, generate, prefill
 
 __all__ = [
     "TransformerConfig",
@@ -33,4 +34,7 @@ __all__ = [
     "init_moe_params",
     "moe_ffn",
     "moe_specs",
+    "prefill",
+    "decode_step",
+    "generate",
 ]

@@ -1,0 +1,137 @@
+"""Autoregressive generation with a KV cache — the flagship's inference path.
+
+tpu-first decode: the cache is a preallocated ``(layers, batch, max_seq,
+heads, head_dim)`` pair updated in place with ``dynamic_update_slice`` (no
+shape growth — one compiled step serves every position), the per-step
+attention is one masked dot against the full cache (MXU-shaped, masked by
+position), and the whole generation loop is a single ``lax.scan`` under
+``jit`` — no host round-trips per token. Prefill computes the prompt's
+cache in one batched forward pass.
+
+No reference analogue (btracey/mpi has no models, SURVEY.md §2) — this is
+framework-completeness work: train (`make_train_step`) and serve
+(`generate`) cover the model lifecycle.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .transformer import TransformerConfig, _ffn, _layernorm
+
+__all__ = ["prefill", "decode_step", "generate"]
+
+
+def _proj_qkv(x, blk, dtype):
+    q = jnp.einsum("bsd,dhk->bshk", x, blk["wq"].astype(dtype))
+    k = jnp.einsum("bsd,dhk->bshk", x, blk["wk"].astype(dtype))
+    v = jnp.einsum("bsd,dhk->bshk", x, blk["wv"].astype(dtype))
+    return q, k, v
+
+
+def _attend_cached(q, k_cache, v_cache, n_valid, cfg):
+    """q: (b, s_q, h, hd) attends to cache positions [0, n_valid + s_q)
+    with causal offsets; cache: (b, max_seq, h, hd)."""
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bshk,bthk->bhst", q, k_cache) * scale
+    s_q, t = q.shape[1], k_cache.shape[1]
+    # query i sits at absolute position n_valid + i; it may see cache
+    # columns 0 .. n_valid + i.
+    rows = n_valid + lax.broadcasted_iota(jnp.int32, (s_q, t), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (s_q, t), 1)
+    logits = jnp.where((cols <= rows)[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhst,bthk->bshk", probs.astype(q.dtype), v_cache)
+
+
+def _forward_cached(params, tokens, cache, n_valid, cfg: TransformerConfig):
+    """Run ``tokens`` (b, s) starting at absolute position ``n_valid``,
+    writing their k/v into the cache. Returns (logits, new_cache)."""
+    b, s = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    pos_emb = lax.dynamic_slice_in_dim(
+        params["pos"].astype(cfg.dtype), n_valid, s, axis=0)
+    x = x + pos_emb[None]
+    new_cache = []
+    for i, blk in enumerate(params["blocks"]):
+        h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
+                       blk["ln1"]["bias"].astype(x.dtype))
+        q, k, v = _proj_qkv(h, blk, x.dtype)
+        k_cache = lax.dynamic_update_slice_in_dim(
+            cache[i][0], k, n_valid, axis=1)
+        v_cache = lax.dynamic_update_slice_in_dim(
+            cache[i][1], v, n_valid, axis=1)
+        new_cache.append((k_cache, v_cache))
+        ctx = _attend_cached(q, k_cache, v_cache, n_valid, cfg)
+        x = x + jnp.einsum("bshk,hkd->bsd", ctx, blk["wo"].astype(x.dtype))
+        h = _layernorm(x, blk["ln2"]["scale"].astype(x.dtype),
+                       blk["ln2"]["bias"].astype(x.dtype))
+        y, _ = _ffn(h, blk, cfg, mesh=None)  # aux loss is a train concern
+        x = x + y
+    x = _layernorm(x, params["final_ln"]["scale"].astype(x.dtype),
+                   params["final_ln"]["bias"].astype(x.dtype))
+    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype))
+    return logits, new_cache
+
+
+def _empty_cache(cfg: TransformerConfig, batch: int):
+    shape = (batch, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+    return [(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+            for _ in range(cfg.n_layers)]
+
+
+def prefill(params, prompt: jax.Array, cfg: TransformerConfig):
+    """Batched prompt pass. Returns (last_logits (b, vocab), cache)."""
+    cache = _empty_cache(cfg, prompt.shape[0])
+    logits, cache = _forward_cached(params, prompt, cache, 0, cfg)
+    return logits[:, -1], cache
+
+
+def decode_step(params, token: jax.Array, cache, n_valid,
+                cfg: TransformerConfig):
+    """One incremental step: ``token`` (b,) at absolute position
+    ``n_valid``. Returns (logits (b, vocab), new_cache)."""
+    logits, cache = _forward_cached(params, token[:, None], cache,
+                                    n_valid, cfg)
+    return logits[:, 0], cache
+
+
+def generate(params, prompt: jax.Array, cfg: TransformerConfig,
+             max_new_tokens: int, temperature: float = 0.0,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` (b, s).
+
+    ``temperature == 0`` is greedy argmax; otherwise samples from the
+    tempered softmax (requires ``key``). The decode loop is one
+    ``lax.scan`` — jit-compatible end to end. Returns (b, max_new_tokens).
+    """
+    if prompt.shape[1] + max_new_tokens > cfg.max_seq:
+        raise ValueError(
+            f"mpi_tpu: prompt {prompt.shape[1]} + {max_new_tokens} new "
+            f"tokens exceeds max_seq {cfg.max_seq}")
+    if temperature > 0 and key is None:
+        raise ValueError("mpi_tpu: sampling (temperature > 0) needs a key")
+    last_logits, cache = prefill(params, prompt, cfg)
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused in greedy mode
+
+    def pick(logits, k):
+        if temperature > 0:
+            return jax.random.categorical(k, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def step(carry, k):
+        logits, cache, n_valid = carry
+        tok = pick(logits, k)
+        new_logits, cache = decode_step(params, tok, cache, n_valid, cfg)
+        return (new_logits, cache, n_valid + 1), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _, _), toks = lax.scan(
+        step, (last_logits, cache, jnp.int32(prompt.shape[1])), keys)
+    return toks.T  # (b, max_new_tokens)
