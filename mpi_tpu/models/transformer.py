@@ -58,8 +58,9 @@ class TransformerConfig:
     dtype: Any = jnp.float32          # compute dtype (bfloat16 on TPU)
     param_dtype: Any = jnp.float32    # master params
     # "dense" | "flash" (Pallas kernel, mpi_tpu.ops) | "blockwise"
-    # (checkpointed scan) | "ring" (sequence-parallel over the sp axis,
-    # mpi_tpu.parallel.ring_attention — requires a mesh).
+    # (checkpointed scan) | "ring" (kv ring over the sp axis,
+    # parallel.ring_attention) | "ulysses" (all-to-all head/seq reshard,
+    # parallel.ulysses). ring/ulysses require a mesh with an 'sp' axis.
     attention_impl: str = "dense"
     # Mixture-of-Experts FFN (0 = dense). Experts shard over the 'ep'
     # mesh axis (mpi_tpu.models.moe); aux load-balance loss is added to
@@ -184,6 +185,13 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
             raise ValueError(
                 "attention_impl='ring' needs a mesh with an 'sp' axis")
         ctx = ring_attention_sharded(q, k, v, mesh, axis_name="sp")
+    elif impl == "ulysses":
+        from ..parallel.ulysses import ulysses_attention_sharded
+
+        if mesh is None:
+            raise ValueError(
+                "attention_impl='ulysses' needs a mesh with an 'sp' axis")
+        ctx = ulysses_attention_sharded(q, k, v, mesh, axis_name="sp")
     elif impl == "dense":
         from ..ops import dense_attention
 
@@ -191,7 +199,7 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     else:
         raise ValueError(
             f"unknown attention_impl {impl!r}: expected dense|flash|"
-            f"blockwise|ring")
+            f"blockwise|ring|ulysses")
     return jnp.einsum("bshk,hkd->bsd", ctx, blk["wo"].astype(x.dtype))
 
 

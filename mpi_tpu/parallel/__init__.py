@@ -18,6 +18,8 @@ from .collectives import (
     tree_allreduce,
 )
 from .ring_attention import ring_attention, ring_attention_sharded
+from .pipeline import pipeline, pipeline_sharded
+from .ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
     "make_mesh",
@@ -25,6 +27,10 @@ __all__ = [
     "rank_axis",
     "ring_attention",
     "ring_attention_sharded",
+    "pipeline",
+    "pipeline_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
     "allgather",
     "allreduce",
     "alltoall",

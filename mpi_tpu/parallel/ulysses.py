@@ -1,0 +1,77 @@
+"""Ulysses-style sequence parallelism — all-to-all head/sequence reshard.
+
+The second long-context strategy next to ring attention
+(:mod:`mpi_tpu.parallel.ring_attention`): instead of rotating k/v around
+the ring, one ``lax.all_to_all`` re-shards q/k/v from sequence-sharded
+``(b, s/n, h, d)`` to head-sharded ``(b, s, h/n, d)``, each device runs
+ordinary (flash/blockwise) attention over the *full* sequence for its
+subset of heads, and a second all-to-all restores sequence sharding
+(DeepSpeed-Ulysses dataflow). Compared to the ring: 2 all-to-alls of the
+activations instead of ``n-1`` k/v hops — cheaper for moderate sequence
+lengths and deep head counts, but requires ``heads % sp == 0`` and peak
+memory O(s) per device (the ring stays O(s/n)).
+
+No reference analogue (SURVEY.md §5: no ML code in btracey/mpi) — this is
+long-context capability work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import blockwise_attention
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "sp", causal: bool = True,
+                      block_k: int = 128) -> jax.Array:
+    """Per-device body (inside shard_map over ``axis_name``): shards are
+    ``(batch, seq_local, heads, head_dim)``; returns the same shape."""
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            f"mpi_tpu: ulysses needs heads ({h}) divisible by the sp axis "
+            f"size ({n}); use ring attention otherwise")
+    if n == 1:
+        return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+
+    def to_heads(x):  # (b, s/n, h, d) -> (b, s, h/n, d)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    ctx = blockwise_attention(qh, kh, vh, causal=causal, block_k=block_k)
+    # (b, s, h/n, d) -> (b, s/n, h, d)
+    return lax.all_to_all(ctx, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                              mesh, axis_name: str = "sp",
+                              causal: bool = True,
+                              batch_axis: Optional[str] = "dp",
+                              head_axis: Optional[str] = None) -> jax.Array:
+    """shard_map wrapper over global ``(b, s, h, d)`` arrays. Heads may
+    not additionally be tp-sharded here (the all-to-all owns the head
+    axis), so ``head_axis`` defaults to None."""
+    names = mesh.axis_names
+    if axis_name not in names:
+        raise ValueError(
+            f"mesh {names} has no {axis_name!r} axis for ulysses")
+    spec = P(batch_axis if batch_axis in names else None,
+             axis_name,
+             head_axis if head_axis in names else None,
+             None)
+    body = functools.partial(ulysses_attention, axis_name=axis_name,
+                             causal=causal)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)

@@ -137,3 +137,14 @@ def test_ring_impl_training_step_runs_sharded():
     state, l1 = step(state, toks)
     assert np.isfinite(float(l0)) and np.isfinite(float(l1))
     assert float(l1) < float(l0)
+
+
+def test_ulysses_attention_impl_in_sharded_model():
+    cfg = dataclasses_replace(CFG, attention_impl="ulysses")
+    mesh = make_mesh_nd(8)  # dp=2, sp=2, tp=2
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    toks = _tokens()[:, :-1]
+    want = jax.jit(lambda p, t: forward(p, t, CFG))(params, toks)
+    got = jax.jit(lambda p, t: forward(p, t, cfg, mesh))(params, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
