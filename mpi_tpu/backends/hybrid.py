@@ -251,30 +251,52 @@ class HybridNetwork:
 
     def scatter(self, data: Optional[List[Any]], root: int = 0) -> Any:
         h = self._host_of(root)
+        # The TCP leg always carries a ``(status, payload)`` envelope so an
+        # invalid list raises a clean MpiError on *every* rank of *every*
+        # host — the leaders relay the verdict over TCP and then to their
+        # local ranks via the inner bcast, so nobody commits to a blocking
+        # scatter that will never be fed.
         if h == self._tcp.rank():
             # Move the item list to the host leader (one gather hop, not a
             # full local bcast), chunk per host, TCP scatter the chunks,
-            # then local scatter. Validation happens in the local gather's
-            # leader so a bad list raises on every local rank.
+            # then local scatter.
             gathered = self._inner.gather(data, root=0)
             chunk = None
+            items = None
+            error = None
             if self._local() == 0:
                 items = gathered[root - self._my_offset]
                 if items is None or len(items) != self._size:
-                    raise MpiError(
-                        f"mpi_tpu: scatter root needs a list of exactly "
-                        f"{self._size} payloads")
+                    error = (f"mpi_tpu: scatter root needs a list of "
+                             f"exactly {self._size} payloads")
                 if self._nhosts() > 1:
-                    chunks = [items[self._offsets[i]:
-                                    self._offsets[i] + self._counts[i]]
-                              for i in range(self._nhosts())]
-                    G.scatter(self._tcp, chunks, root=h)
+                    if error is not None:
+                        envelopes = [("err", error)] * self._nhosts()
+                    else:
+                        envelopes = [
+                            ("ok", items[self._offsets[i]:
+                                         self._offsets[i] + self._counts[i]])
+                            for i in range(self._nhosts())
+                        ]
+                    G.scatter(self._tcp, envelopes, root=h)
+            error = self._inner.bcast(error, root=0)
+            if error is not None:
+                raise MpiError(error)
+            if self._local() == 0:
                 chunk = items[self._my_offset:
                               self._my_offset + self._local_n]
             return self._inner.scatter(chunk, root=0)
         chunk = None
+        error = None
         if self._local() == 0:
-            chunk = G.scatter(self._tcp, None, root=h)
+            status, payload = G.scatter(self._tcp, None, root=h)
+            if status == "err":
+                error = payload
+            else:
+                chunk = payload
+        error = self._inner.bcast(error, root=0)
+        if error is not None:
+            raise MpiError(error)
         return self._inner.scatter(chunk, root=0)
 
     def alltoall(self, data: List[Any]) -> List[Any]:

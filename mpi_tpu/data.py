@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, Iterator, Optional
 
 import numpy as np
@@ -59,19 +60,28 @@ def from_token_array(tokens: np.ndarray, batch: int, seq: int,
             f"mpi_tpu: corpus has {n_windows} windows of {seq} tokens — "
             f"fewer than one batch of {batch}")
     windows_per_epoch = n_windows // batch * batch
-    perm_cache: dict = {}
+    perm_cache: "OrderedDict[int, np.ndarray]" = OrderedDict()
+    perm_lock = threading.Lock()
 
     def _order(epoch: int) -> np.ndarray:
         if shuffle_seed is None:
             return np.arange(n_windows)
         # One O(n_windows) permutation per *epoch*, not per step — at
-        # memmap-corpus scale the per-step cost must stay O(batch).
-        if epoch not in perm_cache:
-            perm_cache.clear()  # only the current epoch is ever needed
-            rng = np.random.default_rng(
-                np.random.SeedSequence([shuffle_seed, epoch]))
-            perm_cache[epoch] = rng.permutation(n_windows)
-        return perm_cache[epoch]
+        # memmap-corpus scale the per-step cost must stay O(batch). The
+        # two most-recently-*used* epochs are kept (not one) so iterators
+        # straddling an epoch boundary — or a lagging iterator sharing
+        # the source — don't thrash the permutation; the lock keeps
+        # concurrent callers coherent.
+        with perm_lock:
+            if epoch in perm_cache:
+                perm_cache.move_to_end(epoch)
+            else:
+                rng = np.random.default_rng(
+                    np.random.SeedSequence([shuffle_seed, epoch]))
+                perm_cache[epoch] = rng.permutation(n_windows)
+                while len(perm_cache) > 2:
+                    perm_cache.popitem(last=False)
+            return perm_cache[epoch]
 
     def sample(step: int) -> np.ndarray:
         idx0 = step * batch

@@ -70,16 +70,31 @@ def save_checkpoint(directory: str, state: Any, step: int,
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump({"step": step, "keys": keys,
                        "format": "mpi_tpu.checkpoint.v1"}, f)
-        # Overwrite atomically: park the old step under a temp name before
-        # the rename so a crash in between leaves either the old or the
-        # new checkpoint complete, never neither.
+        # Overwrite near-atomically: park the old step under a
+        # ``.step_N.old.*`` name before renaming the new one in. A crash
+        # between the two renames leaves no ``step_N`` but an intact
+        # parked copy — ``all_steps`` recovers it (see ``_recover_old``),
+        # so either the old or the new checkpoint is always reachable.
+        # A concurrent reader's recovery can resurrect the parked copy
+        # in that same window, making our rename land on a non-empty
+        # dir — park-and-rename retries until it wins (the resurrector
+        # acts at most once per parked dir, so this converges).
         old = None
-        if os.path.exists(final):
-            old = tempfile.mkdtemp(prefix=f".step_{step}.old.",
-                                   dir=directory)
-            os.rmdir(old)
-            os.rename(final, old)
-        os.rename(tmp, final)
+        for attempt in range(10):
+            if os.path.exists(final):
+                old = tempfile.mkdtemp(prefix=f".step_{step}.old.",
+                                       dir=directory)
+                os.rmdir(old)
+                os.rename(final, old)
+            try:
+                os.rename(tmp, final)
+                break
+            except OSError:
+                if attempt == 9:
+                    if old is not None and not os.path.exists(final):
+                        os.rename(old, final)  # put the old one back
+                        old = None
+                    raise
         if old is not None:
             shutil.rmtree(old, ignore_errors=True)
     except BaseException:
@@ -93,10 +108,37 @@ def save_checkpoint(directory: str, state: Any, step: int,
     return final
 
 
+_OLD_RE = re.compile(r"^\.step_(\d+)\.old\.")
+
+
+def _recover_old(directory: str) -> None:
+    """Restore checkpoints orphaned by a crash mid-overwrite.
+
+    ``save_checkpoint`` parks the previous ``step_N`` as ``.step_N.old.*``
+    before renaming the replacement in; a crash between the renames leaves
+    only the parked copy. Rename it back so the step stays visible."""
+    for name in os.listdir(directory):
+        m = _OLD_RE.match(name)
+        if not m:
+            continue
+        final = os.path.join(directory, f"step_{m.group(1)}")
+        parked = os.path.join(directory, name)
+        if os.path.exists(final):
+            # The replacement landed; the parked copy is leftover debris.
+            shutil.rmtree(parked, ignore_errors=True)
+        elif os.path.exists(os.path.join(parked, "meta.json")):
+            try:
+                os.rename(parked, final)
+            except OSError:
+                pass  # concurrent writer raced us; next scan cleans up
+
+
 def all_steps(directory: str) -> List[int]:
-    """Complete checkpoint steps present, ascending."""
+    """Complete checkpoint steps present, ascending (recovering any step
+    orphaned by a crash mid-overwrite first)."""
     if not os.path.isdir(directory):
         return []
+    _recover_old(directory)
     steps = []
     for name in os.listdir(directory):
         m = _STEP_RE.match(name)
@@ -129,6 +171,9 @@ def restore_checkpoint(directory: str, template: Any,
             raise FileNotFoundError(
                 f"mpi_tpu: no checkpoints under {directory!r}")
     path = os.path.join(directory, f"step_{step}")
+    if not os.path.exists(path) and os.path.isdir(directory):
+        # The explicit-step path must see crash-orphaned steps too.
+        _recover_old(directory)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     with np.load(os.path.join(path, "arrays.npz")) as npz:

@@ -1,0 +1,168 @@
+"""Compiled tagged point-to-point (mpi_tpu.parallel.p2p).
+
+Covers the in-jit Send/Receive lowering (VERDICT round-1 item 2): static
+patterns as one ppermute, tagged channels, the Pallas remote-DMA twin,
+and the XlaNetwork DevicePipe path (a tagged exchange of device arrays
+with no host round-trip of the payload).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_tpu.parallel import make_mesh
+from mpi_tpu.parallel import p2p
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(N)
+
+
+def _blocks(seed=0, shape=(4,)):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((N, *shape)).astype(np.float32)
+
+
+def _shard(mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P("rank")))
+
+
+class TestExchange:
+    def test_ring_shift(self, mesh):
+        x = _blocks()
+        perm = [(r, (r + 1) % N) for r in range(N)]
+        out = np.asarray(p2p.exchange_sharded(_shard(mesh, x), mesh, perm))
+        np.testing.assert_array_equal(out, np.roll(x, 1, axis=0))
+
+    def test_partial_pattern_zero_fills(self, mesh):
+        x = _blocks(1)
+        out = np.asarray(
+            p2p.exchange_sharded(_shard(mesh, x), mesh, [(0, 3), (5, 1)]))
+        expect = np.zeros_like(x)
+        expect[3] = x[0]
+        expect[1] = x[5]
+        np.testing.assert_array_equal(out, expect)
+
+    def test_jit_compiled(self, mesh):
+        """The exchange is a single jitted program (no host round-trip)."""
+        perm = [(r, (r + 1) % N) for r in range(N)]
+        fn = jax.jit(lambda x: p2p.exchange_sharded(x, mesh, perm))
+        x = _shard(mesh, _blocks(2))
+        np.testing.assert_array_equal(
+            np.asarray(fn(x)), np.roll(np.asarray(x), 1, axis=0))
+        # Compiles to a single executable containing a collective-permute.
+        hlo = fn.lower(x).compile().as_text()
+        assert "collective-permute" in hlo
+
+    def test_duplicate_sender_rejected(self, mesh):
+        with pytest.raises(ValueError, match="sends twice"):
+            p2p.exchange_sharded(_shard(mesh, _blocks()), mesh,
+                                 [(0, 1), (0, 2)])
+
+    def test_duplicate_receiver_rejected(self, mesh):
+        with pytest.raises(ValueError, match="receives twice"):
+            p2p.exchange_sharded(_shard(mesh, _blocks()), mesh,
+                                 [(0, 1), (2, 1)])
+
+    def test_out_of_range_pair(self):
+        with pytest.raises(ValueError, match="out of range"):
+            p2p._check_pattern([(0, 9)], n=N)
+
+
+class TestTaggedExchange:
+    def test_two_channels_dont_mix(self, mesh):
+        """Two tags between overlapping ranks stay independent — the
+        tagManager demux contract (network.go:449-497) at trace time."""
+        xa, xb = _blocks(3), _blocks(4)
+        sends = {7: [(0, 1)], 11: [(1, 0), (0, 2)]}
+
+        def body(a, b):
+            out = p2p.tagged_exchange({7: a, 11: b}, sends)
+            return out[7], out[11]
+
+        fn = jax.jit(jax.shard_map(body, mesh=mesh,
+                                   in_specs=(P("rank"), P("rank")),
+                                   out_specs=(P("rank"), P("rank")),
+                                   check_vma=False))
+        oa, ob = fn(_shard(mesh, xa), _shard(mesh, xb))
+        oa, ob = np.asarray(oa), np.asarray(ob)
+        assert np.array_equal(oa[1], xa[0])
+        assert np.array_equal(ob[0], xb[1])
+        assert np.array_equal(ob[2], xb[0])
+        assert not oa[2].any()  # tag 7 sent nothing to rank 2
+
+    def test_tag_set_mismatch(self, mesh):
+        with pytest.raises(ValueError, match="tag mismatch"):
+            p2p.tagged_exchange({1: jnp.zeros(2)}, {2: [(0, 1)]})
+
+
+class TestPallasSendRecv:
+    def test_matches_ppermute_semantics(self, mesh):
+        x = _blocks(5, shape=(8, 128))
+        perm = [(0, 4), (4, 0), (2, 3)]
+        out = np.asarray(p2p.pallas_sendrecv_sharded(
+            _shard(mesh, x), mesh, perm, interpret=True))
+        ref = np.asarray(
+            p2p.exchange_sharded(_shard(mesh, x), mesh, perm))
+        np.testing.assert_array_equal(out, ref)
+
+    def test_ring_parity_with_xla(self, mesh):
+        x = _blocks(6, shape=(8, 128))
+        perm = [(r, (r + 1) % N) for r in range(N)]
+        out = np.asarray(p2p.pallas_sendrecv_sharded(
+            _shard(mesh, x), mesh, perm, interpret=True))
+        np.testing.assert_array_equal(out, np.roll(x, 1, axis=0))
+
+
+class TestDevicePipe:
+    def test_transfer_moves_and_preserves(self):
+        devs = jax.devices()
+        pipe = p2p.DevicePipe()
+        x = jax.device_put(np.arange(12, dtype=np.float32).reshape(3, 4),
+                           devs[0])
+        y = pipe.transfer(x, devs[0], devs[3])
+        assert y.devices() == {devs[3]}
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+    def test_program_cached(self):
+        devs = jax.devices()
+        pipe = p2p.DevicePipe()
+        x = jax.device_put(np.ones((4,), np.float32), devs[1])
+        pipe.transfer(x, devs[1], devs[2])
+        n_progs = len(pipe._progs)
+        pipe.transfer(2 * x, devs[1], devs[2])
+        assert len(pipe._progs) == n_progs  # same executable reused
+
+    def test_xla_network_send_uses_pipe(self):
+        """A tagged device-array exchange through the driver rides the
+        compiled pipe (no host round-trip), and round-trips intact."""
+        import mpi_tpu
+        from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+        devs = jax.devices()
+        net = XlaNetwork(n=4)
+        payload = np.arange(64, dtype=np.float32).reshape(8, 8)
+
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            if r == 0:
+                x = jax.device_put(jnp.asarray(payload), devs[0])
+                mpi_tpu.send(x, 1, tag=5)
+                echo = mpi_tpu.receive(source=1, tag=6)
+                np.testing.assert_array_equal(np.asarray(echo), payload + 1)
+                assert echo.devices() == {devs[0]}
+            elif r == 1:
+                got = mpi_tpu.receive(source=0, tag=5)
+                # Arrived on rank 1's device via the compiled transfer.
+                assert got.devices() == {devs[1]}
+                mpi_tpu.send(jnp.asarray(got) + 1, 0, tag=6)
+            mpi_tpu.finalize()
+
+        run_spmd(main, net=net)
+        assert net._pipe is not None and len(net._pipe._progs) >= 1
