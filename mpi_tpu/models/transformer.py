@@ -59,8 +59,9 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32    # master params
     # "dense" | "flash" (Pallas kernel, mpi_tpu.ops) | "blockwise"
     # (checkpointed scan) | "ring" (kv ring over the sp axis,
-    # parallel.ring_attention) | "ulysses" (all-to-all head/seq reshard,
-    # parallel.ulysses). ring/ulysses require a mesh with an 'sp' axis.
+    # parallel.ring_attention) | "zigzag" (ring with the work-balanced
+    # zigzag causal layout) | "ulysses" (all-to-all head/seq reshard,
+    # parallel.ulysses). ring/zigzag/ulysses need a mesh with 'sp'.
     attention_impl: str = "dense"
     # Mixture-of-Experts FFN (0 = dense). Experts shard over the 'ep'
     # mesh axis (mpi_tpu.models.moe); aux load-balance loss is added to
@@ -178,13 +179,15 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
         from ..ops import blockwise_attention
 
         ctx = blockwise_attention(q, k, v)
-    elif impl == "ring":
+    elif impl in ("ring", "zigzag"):
         from ..parallel.ring_attention import ring_attention_sharded
 
         if mesh is None:
             raise ValueError(
-                "attention_impl='ring' needs a mesh with an 'sp' axis")
-        ctx = ring_attention_sharded(q, k, v, mesh, axis_name="sp")
+                f"attention_impl={impl!r} needs a mesh with an 'sp' axis")
+        layout = "zigzag" if impl == "zigzag" else "contiguous"
+        ctx = ring_attention_sharded(q, k, v, mesh, axis_name="sp",
+                                     layout=layout)
     elif impl == "ulysses":
         from ..parallel.ulysses import ulysses_attention_sharded
 
@@ -199,7 +202,7 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     else:
         raise ValueError(
             f"unknown attention_impl {impl!r}: expected dense|flash|"
-            f"blockwise|ring|ulysses")
+            f"blockwise|ring|zigzag|ulysses")
     return jnp.einsum("bshk,hkd->bsd", ctx, blk["wo"].astype(x.dtype))
 
 

@@ -14,8 +14,10 @@ causal), in increasing tpu-nativeness:
   * :func:`flash_attention` — the Pallas TPU kernel: q/k/v tiles staged
     through VMEM, MXU matmuls with float32 accumulation, running
     (m, l, acc) online-softmax state in VMEM scratch across the key-block
-    grid dimension. Backward runs the checkpointed blockwise
-    implementation under ``jax.vjp`` (recompute, no O(s²) residuals).
+    grid dimension. Backward is the FlashAttention-2 scheme in Pallas
+    too: the forward saves only the log-sum-exp rows, and two kernels
+    (dq over key blocks; dk/dv over query blocks) rebuild the
+    probabilities on the fly — no O(s²) residuals.
 
 All take ``q, k, v`` shaped ``(batch, seq, heads, head_dim)`` — the layout
 :mod:`mpi_tpu.models.transformer` uses — and return the same shape. The
@@ -137,9 +139,41 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # Pallas flash kernel
 # --------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  causal: bool, scale: float, block_q: int, block_k: int,
-                  seq_k: int):
+def _block_mask(qi, ki, block_q: int, block_k: int, causal: bool,
+                seq_k: int):
+    """The (block_q, block_k) validity mask for grid cell (qi, ki)."""
+    row = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    col = ki * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = col < seq_k
+    if causal:
+        valid &= row >= col
+    return valid
+
+
+def _block_probs(q_ref, k_ref, lse_ref, qi, ki, *, causal: bool,
+                 scale: float, block_q: int, block_k: int, seq_k: int):
+    """Backward-pass helper: rebuild this block's softmax probabilities
+    from (q, k, lse) — the FlashAttention-2 trick that replaces O(s²)
+    stored residuals. Returns (q, k, p) in float32."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    valid = _block_mask(qi, ki, block_q, block_k, causal, seq_k)
+    p = jnp.where(valid, jnp.exp(logits - lse_ref[0][:, None]), 0.0)
+    return q, k, p
+
+
+def _flash_kernel_fwd_res(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                          m_scr, l_scr, acc_scr, *, causal: bool,
+                          scale: float, block_q: int, block_k: int,
+                          seq_k: int):
+    """Forward kernel that also emits the log-sum-exp rows — the only
+    residual the backward kernels need (FlashAttention-2 scheme: softmax
+    is reconstructed from (q, k, lse), never stored)."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -157,15 +191,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        row = qi * block_q + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        col = ki * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        valid = col < seq_k
-        if causal:
-            valid &= row >= col
+        valid = _block_mask(qi, ki, block_q, block_k, causal, seq_k)
         logits = jnp.where(valid, logits, _NEG_INF)
-
         m_prev = m_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1))
         p = jnp.exp(logits - m_new[:, None])
@@ -189,6 +216,93 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _():
         l = jnp.maximum(l_scr[:, 0], 1e-30)
         o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:, 0] + jnp.log(l)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, causal: bool, scale: float,
+                         block_q: int, block_k: int, seq_k: int):
+    """dq = Σ_k  ds·K  with ds = P ∘ (dP − δ), P rebuilt from (q, k, lse).
+    Grid (bh, nq, nk): each (bh, qi) accumulates over the key blocks."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        v = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        _, k, p = _block_probs(q_ref, k_ref, lse_ref, qi, ki,
+                               causal=causal, scale=scale, block_q=block_q,
+                               block_k=block_k, seq_k=seq_k)
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                          scale: float, block_q: int, block_k: int,
+                          seq_k: int):
+    """dv = Σ_q Pᵀ·dO and dk = Σ_q dsᵀ·Q. Grid (bh, nk, nq): each
+    (bh, ki) accumulates over the query blocks."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        v = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        q, _, p = _block_probs(q_ref, k_ref, lse_ref, qi, ki,
+                               causal=causal, scale=scale, block_q=block_q,
+                               block_k=block_k, seq_k=seq_k)
+        dv_scr[:] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # Query blocks entirely above the diagonal see nothing of this
+        # key block — skip all four MXU matmuls.
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 try:  # pallas is part of jax, but guard exotic builds
@@ -210,42 +324,6 @@ def _pick_block(n: int, preferred: int) -> int:
     return n
 
 
-def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int,
-                      interpret: bool) -> jax.Array:
-    b, s, h, d = q.shape
-    t = k.shape[1]
-    bq = _pick_block(s, block_q)
-    bk = _pick_block(t, block_k)
-    # (b, s, h, d) -> (b*h, s, d): heads become the embarrassingly parallel
-    # leading grid dimension.
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
-    grid = (b * h, s // bq, t // bk)
-    kernel = functools.partial(
-        _flash_kernel, causal=causal, scale=_scale(q), block_q=bq,
-        block_k=bk, seq_k=t)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),   # running max m
-            pltpu.VMEM((bq, 1), jnp.float32),   # running sum l
-            pltpu.VMEM((bq, d), jnp.float32),   # accumulator
-        ],
-        interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
-
-
 def _should_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -255,7 +333,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_q: int = 128,
                     block_k: int = 128,
                     interpret: Optional[bool] = None) -> jax.Array:
-    """Flash attention: Pallas TPU kernel forward, recompute backward.
+    """Flash attention: Pallas TPU kernels, forward and backward.
 
     ``interpret=None`` auto-selects interpreter mode off-TPU so tests run
     on CPU against the same kernel code. Falls back to
@@ -264,23 +342,131 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     itp = _should_interpret() if interpret is None else interpret
     if not _HAVE_PALLAS:  # pragma: no cover
         return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
-    return _flash_fwd_pallas(q, k, v, causal, block_q, block_k, itp)
+    # Same kernel as the residual-saving forward; the (b*h, s) lse
+    # output is dead here and DCE'd by XLA.
+    return _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k,
+                                 itp)[0]
+
+
+def _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k, interpret):
+    """Forward + log-sum-exp residuals: (out, lse).
+
+    ``out`` is ``(b, s, h, d)``; ``lse`` stays in the kernels' flattened
+    ``(b*h, s)`` layout — exactly what the backward row specs consume."""
+    b, s, h, d = q.shape
+    t = k.shape[1]
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(t, block_k)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    grid = (b * h, s // bq, t // bk)
+    kernel = functools.partial(
+        _flash_kernel_fwd_res, causal=causal, scale=_scale(q), block_q=bq,
+        block_k=bk, seq_k=t)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
+                      interpret):
+    """FlashAttention-2 backward: two Pallas passes (dq over key blocks;
+    dk/dv over query blocks), probabilities rebuilt from lse — no O(s²)
+    residuals, float32 accumulation throughout."""
+    b, s, h, d = q.shape
+    t = k.shape[1]
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(t, block_k)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    gf = g.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    of = out.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # δ_i = Σ_d dO_i·O_i — cheap elementwise reduction; XLA fuses it.
+    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), -1)
+
+    common = dict(causal=causal, scale=_scale(q), block_q=bq, block_k=bk,
+                  seq_k=t)
+    qspec = pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0))
+    kspec = pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0))
+    rowspec = pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(b * h, s // bq, t // bk),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    # dk/dv grid transposes the roles: ki is the accumulation owner, qi
+    # the reduction dimension — index maps swap accordingly.
+    qspec2 = pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0))
+    kspec2 = pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0))
+    rowspec2 = pl.BlockSpec((1, bq), lambda bh, ki, qi: (bh, qi))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(b * h, t // bk, s // bq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    unflat = lambda x, n: x.reshape(b, h, n, d).transpose(0, 2, 1, 3)  # noqa: E731
+    return unflat(dq, s), unflat(dk, t), unflat(dv, t)
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    itp = _should_interpret() if interpret is None else interpret
+    if not _HAVE_PALLAS:  # pragma: no cover
+        out = blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+        return out, (q, k, v, None, None)
+    out, lse = _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k, itp)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # Recompute through the checkpointed blockwise scan — same math, no
-    # O(s²) residuals; a dedicated Pallas backward kernel can slot in here
-    # without touching callers.
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, causal=causal, block_k=block_k), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if out is None:  # pragma: no cover - pallas-less fallback
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: blockwise_attention(
+                q_, k_, v_, causal=causal, block_k=block_k), q, k, v)
+        return vjp(g)
+    itp = _should_interpret() if interpret is None else interpret
+    return _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q,
+                             block_k, itp)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -17,7 +17,13 @@ from .collectives import (
     reduce_scatter,
     tree_allreduce,
 )
-from .ring_attention import ring_attention, ring_attention_sharded
+from .ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+    ring_attention_zigzag,
+    zigzag_indices,
+    zigzag_inverse_indices,
+)
 from .pipeline import pipeline, pipeline_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 
@@ -27,6 +33,9 @@ __all__ = [
     "rank_axis",
     "ring_attention",
     "ring_attention_sharded",
+    "ring_attention_zigzag",
+    "zigzag_indices",
+    "zigzag_inverse_indices",
     "pipeline",
     "pipeline_sharded",
     "ulysses_attention",

@@ -135,3 +135,73 @@ class TestUlysses:
         got = ulysses_attention_sharded(q, k, v, mesh)
         want = dense_attention(q, k, v)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# Zigzag layout (work-balanced causal ring)
+# --------------------------------------------------------------------------
+
+class TestZigzag:
+    def test_indices_roundtrip(self):
+        from mpi_tpu.parallel.ring_attention import (
+            zigzag_indices, zigzag_inverse_indices)
+
+        fwd = zigzag_indices(4, 32)
+        inv = zigzag_inverse_indices(4, 32)
+        np.testing.assert_array_equal(fwd[inv], np.arange(32))
+        # Shard 0 of 4 holds chunks 0 and 7 of the 8-chunk split.
+        np.testing.assert_array_equal(
+            fwd[:8], np.concatenate([np.arange(0, 4), np.arange(28, 32)]))
+
+    @pytest.mark.parametrize("sp", [2, 4, 8])
+    def test_zigzag_matches_dense(self, sp):
+        q, k, v = _qkv(s=32)
+        mesh = _mesh(("sp",), (sp,))
+        got = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                     batch_axis=None, head_axis=None,
+                                     layout="zigzag")
+        want = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_zigzag_under_jit_on_full_mesh(self):
+        q, k, v = _qkv(b=4, s=32, h=4, d=8, seed=2)
+        mesh = _mesh(("dp", "sp", "tp"), (2, 2, 2))
+        fn = jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, mesh, layout="zigzag"))
+        got = fn(q, k, v)
+        want = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_zigzag_rejects_noncausal(self):
+        q, k, v = _qkv()
+        mesh = _mesh(("sp",), (4,))
+        with pytest.raises(ValueError, match="causal"):
+            ring_attention_sharded(q, k, v, mesh, causal=False,
+                                   layout="zigzag")
+
+    def test_zigzag_rejects_indivisible_seq(self):
+        from mpi_tpu.parallel.ring_attention import zigzag_indices
+
+        with pytest.raises(ValueError, match="divisible"):
+            zigzag_indices(4, 20)
+
+    def test_train_step_with_zigzag_attention(self):
+        """attention_impl='zigzag' trains end-to-end on a dp x sp x tp
+        mesh (the VERDICT sp=8-class integration check, scaled to the
+        8-device CI mesh)."""
+        from mpi_tpu.models import TransformerConfig, make_train_step
+        from mpi_tpu.models.transformer import make_mesh_nd
+
+        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq=64,
+                                attention_impl="zigzag")
+        mesh = make_mesh_nd(8)
+        init_state, step = make_train_step(cfg, mesh=mesh)
+        state = init_state(jax.random.PRNGKey(0))
+        tokens = jax.device_put(
+            jnp.asarray(np.random.default_rng(0).integers(
+                0, cfg.vocab, (4, 33)), dtype=jnp.int32),
+            NamedSharding(mesh, P("dp", None)))
+        state, loss1 = step(state, tokens)
+        state, loss2 = step(state, tokens)
+        assert np.isfinite(float(loss1)) and float(loss2) < float(loss1) + 1.0
