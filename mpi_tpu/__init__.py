@@ -37,6 +37,7 @@ from .api import (
     rank,
     receive,
     reduce,
+    reduce_scatter,
     register,
     registered,
     scatter,
@@ -66,6 +67,7 @@ __all__ = [
     "rank",
     "receive",
     "reduce",
+    "reduce_scatter",
     "register",
     "registered",
     "scatter",

@@ -51,6 +51,7 @@ __all__ = [
     "sendrecv",
     "reduce",
     "allreduce",
+    "reduce_scatter",
     "bcast",
     "allgather",
     "gather",
@@ -357,6 +358,15 @@ def allreduce(data: Any, op: str = "sum") -> Any:
 def reduce(data: Any, root: int = 0, op: str = "sum") -> Optional[Any]:
     """Combine across ranks; result only on ``root`` (None elsewhere)."""
     return _collective("reduce", data, root=root, op=op)
+
+
+def reduce_scatter(data: Any, op: str = "sum") -> Any:
+    """Combine ``data`` across ranks, then return only this rank's block:
+    the leading axis splits into ``size`` equal blocks and rank ``i``
+    gets reduced block ``i`` — the bandwidth-optimal half of ring
+    allreduce, exposed directly (ZeRO-style optimizers shard state this
+    way). Requires ``data.shape[0] % size == 0``."""
+    return _collective("reduce_scatter", data, op=op)
 
 
 def bcast(data: Any, root: int = 0) -> Any:

@@ -214,6 +214,22 @@ class HybridNetwork:
         result = self.allreduce(data, op=op)
         return result if self.rank() == root else None
 
+    def reduce_scatter(self, data: Any, op: str = "sum") -> Any:
+        """Hierarchical allreduce, then keep this *global* rank's block
+        (leading axis split across all ranks of all hosts)."""
+        import numpy as _np
+
+        arr = _np.asarray(data)
+        if arr.ndim < 1 or arr.shape[0] % self._size:
+            raise MpiError(
+                f"mpi_tpu: reduce_scatter payload leading axis "
+                f"{arr.shape if arr.ndim else 'scalar'} must divide into "
+                f"{self._size} equal blocks")
+        total = _np.asarray(self.allreduce(data, op=op))
+        m = arr.shape[0] // self._size
+        r = self.rank()
+        return total[r * m:(r + 1) * m]
+
     def barrier(self) -> None:
         self._inner.barrier()
         if self._local() == 0 and self._nhosts() > 1:

@@ -50,6 +50,7 @@ Wire protocol (replaces gob; all integers little-endian)::
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -70,6 +71,12 @@ KIND_HELLO = 2
 
 _FRAME_HDR = struct.Struct("<BqI")
 _DIAL_RETRY_INTERVAL = 0.1  # network.go:298 — 100 ms poll
+
+# The reference's NetProto accepts any `net` package protocol
+# (network.go:26). Supported here: TCP (the default, "tcp4" an alias)
+# and unix-domain stream sockets (addresses = filesystem paths).
+# Anything else raises at init instead of being silently ignored.
+_SUPPORTED_PROTOS = ("tcp", "tcp4", "unix")
 
 
 class InitError(MpiError):
@@ -236,6 +243,11 @@ class TcpNetwork:
                 self._listener.close()
             except OSError:
                 pass
+            if self._is_unix() and self.addr:
+                try:
+                    os.unlink(self.addr)
+                except OSError:
+                    pass
         for peer in self._peers.values():
             for sock in (peer.dial_sock, peer.listen_sock):
                 if sock is not None:
@@ -303,11 +315,23 @@ class TcpNetwork:
 
     # -- bootstrap ----------------------------------------------------------
 
+    def _is_unix(self) -> bool:
+        return self.proto == "unix"
+
+    def _tune(self, sock: socket.socket) -> None:
+        """Latency tuning where applicable (no-op for unix sockets)."""
+        if not self._is_unix():
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
     def _use_flags(self) -> None:
         """Explicit fields win over flags/env (network.go:69-90)."""
         fl = flagmod.get_flags()
         if self.proto is None:
             self.proto = fl.protocol or flagmod.DEFAULT_PROTOCOL
+        if self.proto not in _SUPPORTED_PROTOS:
+            raise InitError(
+                f"mpi_tpu: unsupported -mpi-protocol {self.proto!r}; "
+                f"supported: {', '.join(_SUPPORTED_PROTOS)}")
         if self.addr is None and fl.addr:
             self.addr = fl.addr
         if not self.addrs and fl.alladdr:
@@ -356,13 +380,31 @@ class TcpNetwork:
                 errors.append(err)
 
         # Listen side: accept n-1 peers, each validated by handshake.
-        host, port = _split_hostport(self.addr)
-        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        try:
-            listener.bind((host, port))
-        except OSError as exc:
-            raise InitError(f"mpi_tpu: cannot listen on {self.addr!r}: {exc}") from exc
+        if self._is_unix():
+            listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                # Clear a stale socket file from a crashed previous run;
+                # a *live* conflicting listener still fails below, as the
+                # reference's bind would.
+                os.unlink(self.addr)
+            except OSError:
+                pass
+            try:
+                listener.bind(self.addr)
+            except OSError as exc:
+                raise InitError(
+                    f"mpi_tpu: cannot listen on {self.addr!r}: {exc}"
+                ) from exc
+        else:
+            host, port = _split_hostport(self.addr)
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                listener.bind((host, port))
+            except OSError as exc:
+                raise InitError(
+                    f"mpi_tpu: cannot listen on {self.addr!r}: {exc}"
+                ) from exc
         listener.listen(n)
         listener.settimeout(self.timeout)  # accept timeout (network.go:223-234)
         self._listener = listener
@@ -387,7 +429,7 @@ class TcpNetwork:
             """network.go:211-263: read peer hello, validate, reply."""
             try:
                 conn.settimeout(self.timeout)
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._tune(conn)
                 kind, claimed_id, payload = _recv_frame(conn)
                 if kind != KIND_HELLO:
                     raise InitError(f"expected HELLO, got frame kind {kind}")
@@ -413,23 +455,37 @@ class TcpNetwork:
 
         def dial_handshake(peer_rank: int) -> None:
             """network.go:297-339: retry-dial peer, send hello, validate reply."""
-            target_host, target_port = _split_hostport(self.addrs[peer_rank])
+            target = self.addrs[peer_rank]
+            if not self._is_unix():
+                target_host, target_port = _split_hostport(target)
             deadline = time.monotonic() + self.timeout
             sock: Optional[socket.socket] = None
             while True:
                 try:
-                    sock = socket.create_connection(
-                        (target_host or "localhost", target_port),
-                        timeout=self.timeout)
+                    if self._is_unix():
+                        sock = socket.socket(socket.AF_UNIX,
+                                             socket.SOCK_STREAM)
+                        sock.settimeout(self.timeout)
+                        sock.connect(target)
+                    else:
+                        sock = socket.create_connection(
+                            (target_host or "localhost", target_port),
+                            timeout=self.timeout)
                     break
                 except OSError as exc:
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        sock = None
                     if time.monotonic() >= deadline:
-                        note(f"rank {me}: dial {self.addrs[peer_rank]!r} "
+                        note(f"rank {me}: dial {target!r} "
                              f"timed out: {exc}")
                         return
                     time.sleep(_DIAL_RETRY_INTERVAL)
             try:
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._tune(sock)
                 lock = threading.Lock()
                 _send_frame(sock, lock, KIND_HELLO, me,
                             self.password.encode("utf-8"))

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import copy
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -184,6 +185,7 @@ class XlaNetwork:
         self._pairs: Dict[Tuple[int, int], Rendezvous] = {}
         self._pairs_lock = threading.Lock()
         self._jit_cache: Dict[Tuple, Any] = {}
+        self._fillers: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._pipe = None  # lazy DevicePipe (compiled p2p transfers)
         self._initialized = False
         self.deterministic_collectives = deterministic_collectives
@@ -361,12 +363,15 @@ class XlaNetwork:
                         key=lambda s: s.index[0].start or 0)
         return [np.asarray(s.data)[0] for s in shards]
 
-    def _collective_fn(self, kind: str, op: str, deterministic: bool):
-        key = (kind, op, deterministic)
+    def _collective_fn(self, kind: str, op: str = "",
+                       deterministic: bool = False, root: int = 0):
+        key = (kind, op, deterministic, root) if kind == "bcast" \
+            else (kind, op, deterministic)
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
         jax = _jax()
+        from jax import lax
         from jax.sharding import PartitionSpec as P
 
         from ..parallel import collectives as C
@@ -385,6 +390,38 @@ class XlaNetwork:
                 return C.allgather(x, "rank", axis=0, tiled=True)
 
             out_specs = P()
+        elif kind == "alltoall":
+            def per_shard(x):
+                # x: (1, n, *shape) — row j is my payload for rank j;
+                # after the exchange, slot j holds rank j's payload to me.
+                return C.alltoall(x, "rank", split_axis=1, concat_axis=1)
+
+            out_specs = P("rank")
+        elif kind == "bcast":
+            def per_shard(x):
+                # x: (1, *shape) block, real data only on root's shard
+                # (fillers elsewhere); the all_gather + static index is
+                # XLA's broadcast idiom over ICI.
+                return C.bcast(x, root, "rank")
+
+            out_specs = P()
+        elif kind == "reduce_scatter":
+            def per_shard(x):
+                # x: (1, L, *shape); each rank keeps its reduced L/n block.
+                y = x[0]
+                if deterministic:
+                    # Binomial-tree order → bitwise parity with the
+                    # generic driver's reduce-then-slice.
+                    total = C.tree_allreduce(y, "rank", op=op)
+                    shard = y.shape[0] // lax.axis_size("rank")
+                    idx = lax.axis_index("rank")
+                    out = lax.dynamic_slice_in_dim(total, idx * shard,
+                                                   shard, axis=0)
+                else:
+                    out = C.reduce_scatter(y, "rank", op=op)
+                return out[None]
+
+            out_specs = P("rank")
         else:  # pragma: no cover - future kinds
             raise MpiError(f"unknown collective kind {kind}")
 
@@ -393,6 +430,56 @@ class XlaNetwork:
                                    check_vma=False))
         self._jit_cache[key] = fn
         return fn
+
+    _FILLER_CACHE = 32
+
+    def _filler_shard(self, device, shape, dtype):
+        """A cached zeros block on ``device`` — the placeholder shard for
+        global arrays whose real data lives on one device (bcast input);
+        its contents are never read. LRU-capped like DevicePipe's."""
+        key = (device, shape, str(dtype))
+        arr = self._fillers.get(key)
+        if arr is not None:
+            self._fillers.move_to_end(key)
+            return arr
+        arr = _jax().device_put(np.zeros((1, *shape), dtype), device)
+        self._fillers[key] = arr
+        while len(self._fillers) > self._FILLER_CACHE:
+            self._fillers.popitem(last=False)
+        return arr
+
+    def _canonical_array(self, payload) -> Optional[np.ndarray]:
+        """``payload`` as an ndarray if it can ride a compiled path:
+        array-typed, ndim >= 1, and a dtype XLA will not rewrite
+        (int64/float64 without x64 fall back to the object path, which
+        returns payloads untouched)."""
+        jax = _jax()
+        if self._mesh is None or not isinstance(
+                payload, (np.ndarray, jax.Array)):
+            return None
+        arr = np.asarray(payload)
+        if arr.ndim < 1:
+            return None
+        try:
+            if jax.dtypes.canonicalize_dtype(arr.dtype) != arr.dtype:
+                return None
+        except TypeError:
+            return None
+        return arr
+
+    def _uniform_arrays(self, slots: List[Any]) -> Optional[List[np.ndarray]]:
+        """All payloads canonical arrays of one shape/dtype, else None."""
+        np_slots = []
+        for s in slots:
+            arr = self._canonical_array(s)
+            if arr is None:
+                return None
+            np_slots.append(arr)
+        first = np_slots[0]
+        if not all(s.shape == first.shape and s.dtype == first.dtype
+                   for s in np_slots):
+            return None
+        return np_slots
 
     def allreduce(self, data: Any, op: str = "sum",
                   deterministic: Optional[bool] = None) -> Any:
@@ -438,20 +525,55 @@ class XlaNetwork:
         self._coll.run(self._myrank(), None, lambda slots: [None] * self._n)
 
     def bcast(self, data: Any, root: int = 0) -> Any:
+        """Array payloads broadcast as ONE compiled XLA program: the
+        root's array becomes its shard of a mesh-global input (cached
+        zero fillers stand in elsewhere — never read), and the compiled
+        ``all_gather`` + static index rides ICI. Objects take the
+        in-process handoff (deep-copied per rank); broadcast arrays may
+        alias across ranks — treat them as read-only, as with
+        ``allgather``."""
         self._check_rank(root)
+        jax = _jax()
 
         def leader(slots: List[Any]) -> List[Any]:
             payload = slots[root]
-            return [payload if i == root else copy.deepcopy(payload)
-                    for i in range(self._n)]
+            arr = self._canonical_array(payload)
+            if arr is None:
+                return [payload if i == root else copy.deepcopy(payload)
+                        for i in range(self._n)]
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            shards = [
+                jax.device_put(arr[None], d) if i == root
+                else self._filler_shard(d, arr.shape, arr.dtype)
+                for i, d in enumerate(self._devices)
+            ]
+            garr = jax.make_array_from_single_device_arrays(
+                (self._n, *arr.shape),
+                NamedSharding(self._mesh, P("rank")), shards)
+            out = self._collective_fn("bcast", root=root)(garr)
+            rows = np.asarray(out)[0]
+            return [rows for _ in range(self._n)]
 
         return self._coll.run(self._myrank(), data, leader)
 
     def gather(self, data: Any, root: int = 0) -> Optional[List[Any]]:
+        """Uniform array payloads ride the compiled all_gather program
+        (XLA's ICI-ring collective; the non-root copies are the cost of
+        staying on one compiled path) and only root keeps the result;
+        otherwise in-process handoff."""
         self._check_rank(root)
 
         def leader(slots: List[Any]) -> List[Any]:
-            return [list(slots) if i == root else None
+            np_slots = self._uniform_arrays(slots)
+            if np_slots is None:
+                return [list(slots) if i == root else None
+                        for i in range(self._n)]
+            garr = self._global_array(np_slots)
+            out = self._collective_fn("allgather")(garr)
+            rows = np.asarray(out)
+            gathered = [rows[i] for i in range(self._n)]
+            return [gathered if i == root else None
                     for i in range(self._n)]
 
         return self._coll.run(self._myrank(), data, leader)
@@ -460,34 +582,16 @@ class XlaNetwork:
         """Array payloads of matching shape/dtype gather with ONE compiled
         XLA all_gather over the mesh (ICI on TPU); anything else (objects,
         ragged shapes) uses the in-process handoff. Returned entries may
-        alias between ranks, matching the generic driver's semantics."""
+        alias between ranks, matching the generic driver's semantics.
 
-        jax = _jax()
+        The dtype gate is canonicalization only — anything XLA would
+        rewrite (int64/float64/complex128 without x64) takes the
+        in-process handoff, which returns payloads untouched; bfloat16
+        stays on the compiled path."""
 
         def leader(slots: List[Any]) -> List[Any]:
-            uniform = (
-                self._mesh is not None
-                and all(isinstance(s, (np.ndarray, jax.Array))
-                        and s.ndim >= 1 for s in slots)
-            )
-            if uniform:
-                np_slots = [np.asarray(s) for s in slots]
-                dt = np_slots[0].dtype
-                # allgather is a pass-through, not a reduction: the only
-                # dtype gate is canonicalization — anything XLA would
-                # rewrite (int64/float64/complex128 without x64) takes the
-                # in-process handoff, which returns payloads untouched.
-                # bfloat16 (kind 'V') stays on the compiled path.
-                try:
-                    canonical = jax.dtypes.canonicalize_dtype(dt) == dt
-                except TypeError:
-                    canonical = False
-                uniform = (
-                    canonical
-                    and all(s.shape == np_slots[0].shape and s.dtype == dt
-                            for s in np_slots)
-                )
-            if not uniform:
+            np_slots = self._uniform_arrays(slots)
+            if np_slots is None:
                 return [list(slots) for _ in range(self._n)]
             garr = self._global_array(np_slots)
             out = self._collective_fn("allgather", "", False)(garr)
@@ -500,7 +604,15 @@ class XlaNetwork:
         return self._coll.run(self._myrank(), data, leader)
 
     def scatter(self, data: Optional[List[Any]], root: int = 0) -> Any:
+        """A uniform array list scatters by committing the stacked
+        payload straight to the ``P('rank')`` sharding: argument
+        placement is the one legal entry point for root-local data onto
+        the mesh (an XLA program's inputs must already live on the
+        mesh's devices), and it moves each shard exactly once to its
+        owner. Each rank's result is device-resident on its own device.
+        Mixed payloads take the in-process handoff."""
         self._check_rank(root)
+        jax = _jax()
 
         def leader(slots: List[Any]) -> List[Any]:
             items = slots[root]
@@ -508,19 +620,37 @@ class XlaNetwork:
                 raise MpiError(
                     f"mpi_tpu: scatter root needs a list of exactly "
                     f"{self._n} payloads")
-            return list(items)
+            np_items = self._uniform_arrays(list(items))
+            if np_items is None:
+                return list(items)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            out = jax.device_put(np.stack(np_items),
+                                 NamedSharding(self._mesh, P("rank")))
+            return self._per_rank(out)
 
         return self._coll.run(self._myrank(), data, leader)
 
     def alltoall(self, data: List[Any]) -> List[Any]:
+        """Uniform payload matrices exchange with ONE compiled XLA
+        AllToAll over the mesh; mixed payloads use in-process handoff."""
         if len(data) != self._n:
             raise MpiError(
                 f"mpi_tpu: alltoall needs exactly {self._n} payloads, "
                 f"got {len(data)}")
 
         def leader(slots: List[List[Any]]) -> List[List[Any]]:
-            return [[slots[src][dst] for src in range(self._n)]
-                    for dst in range(self._n)]
+            flat = [p for row in slots for p in row]
+            np_flat = self._uniform_arrays(flat)
+            if np_flat is None:
+                return [[slots[src][dst] for src in range(self._n)]
+                        for dst in range(self._n)]
+            n = self._n
+            stacked = [np.stack(np_flat[i * n:(i + 1) * n])
+                       for i in range(n)]  # (n, *shape) per source rank
+            garr = self._global_array(stacked)          # (n, n, *shape)
+            out = self._collective_fn("alltoall", "", False)(garr)
+            return [list(row) for row in self._per_rank(out)]
 
         return self._coll.run(self._myrank(), data, leader)
 
@@ -528,6 +658,39 @@ class XlaNetwork:
         self._check_rank(root)
         result = self.allreduce(data, op=op)
         return result if self._myrank() == root else None
+
+    def reduce_scatter(self, data: Any, op: str = "sum",
+                       deterministic: Optional[bool] = None) -> Any:
+        """Reduce across ranks and keep this rank's block of the result:
+        the payload's leading axis splits into ``size`` equal blocks and
+        rank ``i`` returns reduced block ``i`` — one compiled
+        ``psum_scatter`` (or the binomial tree + slice when
+        ``deterministic``) over the mesh."""
+        det = (self.deterministic_collectives if deterministic is None
+               else deterministic)
+        from ..collectives_generic import check_op, tree_combine
+
+        check_op(op)
+
+        def leader(slots: List[Any]) -> List[Any]:
+            np_slots = [np.asarray(s) for s in slots]
+            self._validate_payloads(np_slots)
+            shape = np_slots[0].shape
+            if len(shape) < 1 or shape[0] % self._n:
+                raise MpiError(
+                    f"mpi_tpu: reduce_scatter payload leading axis "
+                    f"{shape or 'scalar'} must divide into {self._n} "
+                    f"equal blocks")
+            m = shape[0] // self._n
+            if self._mesh is None:
+                total = tree_combine(np_slots, op)
+                return [total[i * m:(i + 1) * m].copy()
+                        for i in range(self._n)]
+            garr = self._global_array(np_slots)
+            out = self._collective_fn("reduce_scatter", op, det)(garr)
+            return self._per_rank(out)
+
+        return self._coll.run(self._myrank(), data, leader)
 
 
 def drive_rank_threads(fn: Callable[[], Any], *, nranks: int,

@@ -39,6 +39,7 @@ __all__ = [
     "tree_combine",
     "reduce",
     "allreduce",
+    "reduce_scatter",
     "bcast",
     "gather",
     "scatter",
@@ -187,6 +188,26 @@ def allreduce(impl: Interface, data: Any, op: str = "sum") -> Any:
     tag = _next_tag_base(impl)
     result = reduce(impl, data, root=0, op=op, _tag_base=tag)
     return bcast(impl, result, root=0, _tag_base=tag + 64)
+
+
+def reduce_scatter(impl: Interface, data: Any, op: str = "sum") -> Any:
+    """Reduce across ranks, then keep this rank's block: the payload's
+    leading axis splits into ``size`` equal blocks and rank ``i`` returns
+    reduced block ``i``. Combination order is the canonical binomial tree
+    (reduce-then-slice), so results are bitwise-identical to the XLA
+    driver's deterministic path."""
+    check_op(op)
+    arr = np.asarray(data)
+    n = impl.size()
+    if arr.ndim < 1 or arr.shape[0] % n:
+        raise MpiError(
+            f"mpi_tpu: reduce_scatter payload leading axis "
+            f"{arr.shape if arr.ndim else 'scalar'} must divide into {n} "
+            f"equal blocks")
+    total = np.asarray(allreduce(impl, data, op=op))
+    m = arr.shape[0] // n
+    me = impl.rank()
+    return total[me * m:(me + 1) * m]
 
 
 def gather(impl: Interface, data: Any, root: int = 0) -> Optional[List[Any]]:

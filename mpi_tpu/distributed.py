@@ -1,0 +1,120 @@
+"""``jax.distributed`` multi-process bring-up on the ``-mpi-*`` flag ABI.
+
+The reference's cluster story is "every process receives
+``-mpi-addr``/``-mpi-alladdr`` and derives its rank from the sorted
+address list" (/root/reference/network.go:94-109); its bootstrap is then
+an O(N²) socket handshake. The tpu-native multi-host bootstrap is
+``jax.distributed.initialize`` — one coordinator, everyone else dials it,
+and afterwards ``jax.devices()`` spans every chip of every process so
+GSPMD programs (and their collectives) run globally over ICI/DCN.
+
+This module reuses the reference's flag ABI verbatim for that bring-up:
+
+  * **process id** = index of own address in the sorted address list —
+    the exact ``assignRanks`` rule (network.go:94-109), so the launcher
+    needs no new protocol;
+  * **coordinator** = owner of the first sorted address (rank 0), the
+    deterministic-leaderless analogue of the reference's "everyone knows
+    everyone" bootstrap.
+
+Usage (the launcher injects the flags, ``python -m mpi_tpu.launch.mpirun
+--distributed N prog.py``)::
+
+    import mpi_tpu.distributed as dist
+
+    dist.initialize_from_flags()       # jax.distributed handshake
+    mesh = dist.global_mesh()          # all devices of all processes
+    # ... shard_map / pjit programs over `mesh`; use
+    # jax.make_array_from_process_local_data for per-process inputs.
+
+The imperative thread-per-rank drivers are deliberately NOT layered over
+this: a multi-process mesh is a single-program SPMD world (every process
+runs the same compiled collectives), which is the functional layer's
+programming model. The hybrid driver remains the imperative multi-host
+path (XLA within a host, TCP between hosts).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from . import flags as flagmod
+from .api import MpiError
+
+__all__ = [
+    "resolve_topology",
+    "initialize_from_flags",
+    "initialize",
+    "global_mesh",
+]
+
+_DEFAULT_HOST = "127.0.0.1"
+
+
+def resolve_topology(addr: Optional[str] = None,
+                     addrs: Optional[Sequence[str]] = None
+                     ) -> Tuple[str, int, int]:
+    """(coordinator_address, num_processes, process_id) from the sorted
+    address list — pure function, unit-testable without jax."""
+    if addr is None or addrs is None:
+        fl = flagmod.get_flags()
+        addr = addr if addr is not None else fl.addr
+        addrs = list(addrs) if addrs is not None else list(fl.alladdr or [])
+    if not addr or not addrs:
+        raise MpiError(
+            "mpi_tpu: distributed mode needs --mpi-addr and --mpi-alladdr "
+            "(the launcher injects them; see mpi_tpu.launch.mpirun)")
+    ordered = sorted(addrs)
+    for a, b in zip(ordered, ordered[1:]):
+        if a == b:
+            raise MpiError(
+                f"mpi_tpu: duplicate address {a!r} in --mpi-alladdr")
+    try:
+        pid = ordered.index(addr)
+    except ValueError:
+        raise MpiError(
+            f"mpi_tpu: own address {addr!r} not in --mpi-alladdr "
+            f"{ordered}") from None
+    coord = ordered[0]
+    if coord.startswith(":"):
+        # Bare ":port" addresses (the launcher's localhost form) need a
+        # dialable host for everyone else.
+        coord = _DEFAULT_HOST + coord
+    return coord, len(ordered), pid
+
+
+def initialize(coordinator: str, num_processes: int, process_id: int,
+               local_device_ids: Optional[List[int]] = None) -> None:
+    """Thin wrapper over ``jax.distributed.initialize`` (idempotence
+    guard included: a second call in one process is an error in jax)."""
+    import jax
+
+    state = getattr(jax._src.distributed, "global_state", None)
+    if state is not None and getattr(state, "client", None) is not None:
+        raise MpiError(
+            "mpi_tpu: jax.distributed already initialized in this process")
+    kwargs = {}
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id, **kwargs)
+
+
+def initialize_from_flags(addr: Optional[str] = None,
+                          addrs: Optional[Sequence[str]] = None) -> int:
+    """Bring up ``jax.distributed`` from the ``-mpi-*`` flag ABI; returns
+    this process's id. After this, ``jax.devices()`` is global while
+    ``jax.local_devices()`` is this process's share."""
+    coord, n, pid = resolve_topology(addr, addrs)
+    if n > 1:
+        initialize(coord, n, pid)
+    return pid
+
+
+def global_mesh(axis: str = "rank"):
+    """A 1-D mesh over every device of every process (call after
+    :func:`initialize_from_flags`)."""
+    from .parallel.mesh import make_mesh
+
+    return make_mesh(axis=axis)

@@ -53,44 +53,61 @@ def moe_specs() -> Dict[str, P]:
 
 def moe_ffn(x: jax.Array, params: Dict[str, Any], n_experts: int,
             capacity_factor: float = 1.25,
-            mesh: Optional[Mesh] = None) -> Tuple[jax.Array, jax.Array]:
-    """Top-1 routed expert FFN.
+            mesh: Optional[Mesh] = None,
+            top_k: int = 1) -> Tuple[jax.Array, jax.Array]:
+    """Top-k routed expert FFN (GShard-style; ``top_k=1`` is Switch).
 
     ``x``: (batch, seq, d_model). Returns ``(y, aux)`` where ``y`` has
-    x's shape (overflowed tokens produce zeros — the caller's residual
-    stream carries them through) and ``aux`` is the load-balancing loss
-    (Shazeer et al.: ``E * sum_e fraction_tokens_e * mean_prob_e``,
-    minimised at uniform routing).
+    x's shape (fully-overflowed tokens produce zeros — the caller's
+    residual stream carries them through) and ``aux`` is the
+    load-balancing loss (Shazeer et al.:
+    ``E * sum_e fraction_first_choice_e * mean_prob_e``, minimised at
+    uniform routing; computed on first choices for any k).
 
     Tokens are routed within *groups* (one group per batch row, the
     GShard/Switch recipe): the dispatch one-hots are (groups, seq, E, C)
     with per-group capacity, so memory stays linear in the global token
     count instead of quadratic, and group = batch row keeps routing
     aligned with the dp sharding (no cross-device cumsum).
+
+    Capacity handling for ``k > 1`` follows GShard: per-expert buffers
+    hold ``ceil(k * seq / E * capacity_factor)`` tokens, and slots are
+    claimed choice-major — every token's first choice outranks any
+    token's second choice — so congestion drops k-th choices first.
+    Gates are the raw router probabilities of the surviving choices
+    (matching the k=1 behavior; a dropped choice contributes zero and
+    its share rides the residual).
     """
     b, s, d = x.shape
     e = n_experts
-    capacity = max(1, int(math.ceil(s / e * capacity_factor)))
+    if not 1 <= top_k <= e:
+        raise ValueError(
+            f"mpi_tpu: moe top_k={top_k} must be in [1, n_experts={e}]")
+    capacity = max(1, int(math.ceil(top_k * s / e * capacity_factor)))
 
     logits = jnp.einsum("gnd,de->gne", x, params["router"].astype(x.dtype))
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    gate = jnp.max(probs, axis=-1)                  # (G, N)
-    expert = jnp.argmax(probs, axis=-1)             # (G, N)
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.int32)  # (G, N, E)
+    topk_probs, topk_idx = lax.top_k(probs, top_k)       # (G, N, K)
+    onehot_k = jax.nn.one_hot(topk_idx, e, dtype=jnp.int32)  # (G, N, K, E)
 
-    # Position of each token within its expert's per-group buffer
-    # (exclusive int cumsum in token order — deterministic priority, and
-    # exact for any token count, unlike a float32 cumsum).
-    pos = jnp.cumsum(onehot, axis=1) - onehot       # (G, N, E)
-    pos = jnp.einsum("gne,gne->gn", pos, onehot)    # (G, N) int32
-    kept = pos < capacity
-    gate = jnp.where(kept, gate, 0.0)
+    # Slot positions, choice-major priority: order all first choices in
+    # token order, then all second choices, ... (exclusive int cumsum —
+    # deterministic, exact). pos[(g, n, k)] = slot index within the
+    # chosen expert's group-g buffer.
+    ordered = onehot_k.transpose(0, 2, 1, 3).reshape(b, top_k * s, e)
+    pos_flat = jnp.cumsum(ordered, axis=1) - ordered
+    pos = jnp.einsum("gme,gme->gm", pos_flat, ordered)
+    pos = pos.reshape(b, top_k, s).transpose(0, 2, 1)    # (G, N, K)
+    kept = pos < capacity                                # (G, N, K)
+    gates = jnp.where(kept, topk_probs, 0.0)
 
     # dispatch[g, n, e', c] = 1 iff token (g, n) sits in slot c of
-    # expert e''s group-g buffer.
-    dispatch = (onehot * kept[..., None]).astype(jnp.float32)[..., None] \
-        * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[..., None, :]
-    combine = dispatch * gate[..., None, None]      # (G, N, E, C)
+    # expert e''s group-g buffer (via any of its k choices — top_k gives
+    # distinct experts, so slots never collide).
+    slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # (G, N, K, C)
+    sel = (onehot_k * kept[..., None]).astype(jnp.float32)   # (G, N, K, E)
+    dispatch = jnp.einsum("gnke,gnkc->gnec", sel, slot)
+    combine = jnp.einsum("gnke,gnkc,gnk->gnec", sel, slot, gates)
 
     xin = jnp.einsum("gnec,gnd->gecd", dispatch.astype(x.dtype), x)
     buf_sharding = None
@@ -109,8 +126,9 @@ def moe_ffn(x: jax.Array, params: Dict[str, Any], n_experts: int,
         y_e = lax.with_sharding_constraint(y_e, buf_sharding)
     y = jnp.einsum("gnec,gecd->gnd", combine.astype(x.dtype), y_e)
 
-    # Load-balance aux: fraction of tokens routed to e x mean router prob.
-    frac = jnp.mean(onehot.astype(jnp.float32), axis=(0, 1))
+    # Load-balance aux: fraction of first-choice tokens per expert x mean
+    # router prob (first choices for any k — the standard GShard form).
+    frac = jnp.mean(onehot_k[:, :, 0, :].astype(jnp.float32), axis=(0, 1))
     mean_prob = jnp.mean(probs, axis=(0, 1))
     aux = e * jnp.sum(frac * mean_prob)
     return y, aux.astype(jnp.float32)

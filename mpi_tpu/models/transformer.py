@@ -65,10 +65,12 @@ class TransformerConfig:
     attention_impl: str = "dense"
     # Mixture-of-Experts FFN (0 = dense). Experts shard over the 'ep'
     # mesh axis (mpi_tpu.models.moe); aux load-balance loss is added to
-    # the training objective with coefficient moe_aux_coef.
+    # the training objective with coefficient moe_aux_coef. moe_top_k
+    # selects routing (1 = Switch, 2 = GShard top-2).
     n_experts: int = 0
     capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    moe_top_k: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -241,7 +243,8 @@ def _ffn(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh]):
         from .moe import moe_ffn
 
         return moe_ffn(x, blk["moe"], cfg.n_experts,
-                       capacity_factor=cfg.capacity_factor, mesh=mesh)
+                       capacity_factor=cfg.capacity_factor, mesh=mesh,
+                       top_k=cfg.moe_top_k)
     h = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, blk["w1"].astype(x.dtype)))
     y = jnp.einsum("bsf,fd->bsd", h, blk["w2"].astype(x.dtype))
     return y, jnp.zeros((), jnp.float32)

@@ -1,0 +1,99 @@
+"""jax.distributed bring-up on the -mpi-* flag ABI (VERDICT item 6).
+
+The topology rule is the reference's sorted-address rank assignment
+(network.go:94-109) applied to processes; the integration test launches
+a real 2-process x 4-virtual-device run through the launcher and checks
+the cross-process allreduce against the single-process result.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mpi_tpu.api import MpiError
+from mpi_tpu.distributed import resolve_topology
+
+from conftest import _free_port_block
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestResolveTopology:
+    def test_sorted_addr_rule(self):
+        coord, n, pid = resolve_topology(
+            ":6001", [":6002", ":6001", ":6000"])
+        assert (coord, n, pid) == ("127.0.0.1:6000", 3, 1)
+
+    def test_hostful_addresses_untouched(self):
+        coord, n, pid = resolve_topology("h1:5000", ["h1:5000", "h0:5000"])
+        assert coord == "h0:5000"
+        assert (n, pid) == (2, 1)
+
+    def test_duplicate_rejected(self):
+        with pytest.raises(MpiError, match="duplicate"):
+            resolve_topology(":1", [":1", ":1"])
+
+    def test_missing_own_addr_rejected(self):
+        with pytest.raises(MpiError, match="not in"):
+            resolve_topology(":9", [":1", ":2"])
+
+    def test_missing_flags_rejected(self):
+        with pytest.raises(MpiError, match="needs --mpi-addr"):
+            resolve_topology("", [])
+
+
+_PROG = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    from mpi_tpu.utils.platform import force_platform
+    force_platform("cpu", 4)
+
+    import numpy as np
+    import mpi_tpu.distributed as dist
+
+    pid = dist.initialize_from_flags()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mpi_tpu.parallel import collectives as C
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, jax.devices()
+    assert len(jax.local_devices()) == 4
+
+    mesh = dist.global_mesh()
+    fn = jax.jit(jax.shard_map(lambda x: C.allreduce(x, "rank"),
+                               mesh=mesh, in_specs=P("rank"),
+                               out_specs=P("rank"), check_vma=False))
+    gdata = np.arange(32, dtype=np.float32).reshape(8, 4)
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("rank")), gdata[pid * 4:(pid + 1) * 4])
+    out = fn(x)
+    # The single-process oracle: plain numpy sum of the global data.
+    want = gdata.sum(axis=0)
+    for s in out.addressable_shards:
+        np.testing.assert_allclose(np.asarray(s.data)[0], want)
+    print(f"DIST-OK pid={{pid}}", flush=True)
+""")
+
+
+def test_two_process_collectives_agree_with_single_process(tmp_path):
+    """One launcher command -> 2 OS processes x 4 virtual CPU devices;
+    the compiled global allreduce matches the numpy oracle on every
+    process (the VERDICT 'done' criterion)."""
+    prog = tmp_path / "dist_prog.py"
+    prog.write_text(_PROG.format(repo=REPO))
+    base = _free_port_block(2)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # The child pins its own platform/device count; the pytest parent's
+    # 8-device XLA_FLAGS must not leak in.
+    env.pop("XLA_FLAGS", None)
+    cp = subprocess.run(
+        [sys.executable, "-m", "mpi_tpu.launch.mpirun",
+         "--port-base", str(base), "2", str(prog)],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    assert cp.returncode == 0, f"stdout:\n{cp.stdout}\nstderr:\n{cp.stderr}"
+    assert cp.stdout.count("DIST-OK") == 2

@@ -93,3 +93,12 @@ def test_generation_with_moe_model():
     out = generate(p, _tokens(s=4), cfg, max_new_tokens=4)
     assert out.shape == (2, 4)
     assert np.asarray(out).min() >= 0 and np.asarray(out).max() < cfg.vocab
+
+
+def test_generation_with_top2_moe_model():
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=1,
+                            d_ff=64, max_seq=32, n_experts=4, moe_top_k=2)
+    p = init_params(jax.random.PRNGKey(2), cfg)
+    out = generate(p, _tokens(s=4), cfg, max_new_tokens=4)
+    assert out.shape == (2, 4)
+    assert np.asarray(out).min() >= 0 and np.asarray(out).max() < cfg.vocab

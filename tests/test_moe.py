@@ -130,3 +130,80 @@ class TestMoeTransformer:
         # 4 experts over ep=4: each shard holds exactly one expert
         shard_shapes = {s.data.shape for s in w1e.addressable_shards}
         assert shard_shapes == {(1, 32, 64)}
+
+
+class TestTopK:
+    def _setup(self, e=4, d=8, f=16, b=2, s=8, seed=0):
+        params = init_moe_params(jax.random.PRNGKey(seed), d, f, e,
+                                 jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(seed + 1), (b, s, d))
+        return params, x
+
+    def test_top2_matches_manual_at_high_capacity(self):
+        """With capacity >= all tokens, top-2 output must equal
+        sum over the two best experts of prob_e * expert_ffn(x)."""
+        params, x = self._setup()
+        y, _ = moe_ffn(x, params, 4, capacity_factor=4.0, top_k=2)
+        xf = x.reshape(-1, x.shape[-1])
+        probs = jax.nn.softmax(xf @ params["router"], axis=-1)
+        want = []
+        for i in range(xf.shape[0]):
+            top2 = np.argsort(-np.asarray(probs[i]))[:2]
+            acc = 0.0
+            for e in top2:
+                h = jax.nn.gelu(xf[i] @ params["w1e"][e])
+                acc = acc + float(probs[i, e]) * (h @ params["w2e"][e])
+            want.append(acc)
+        np.testing.assert_allclose(
+            np.asarray(y).reshape(-1, x.shape[-1]), np.asarray(want),
+            rtol=1e-4, atol=1e-5)
+
+    def test_top1_unchanged_by_topk_path(self):
+        params, x = self._setup()
+        y1, aux1 = moe_ffn(x, params, 4, capacity_factor=4.0, top_k=1)
+        # Legacy call (no top_k arg) must give identical results.
+        y0, aux0 = moe_ffn(x, params, 4, capacity_factor=4.0)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y0))
+        assert float(aux1) == float(aux0)
+
+    def test_top2_overflow_drops_second_choices_first(self):
+        """Choice-major priority: when an expert's buffer fills, every
+        token's first choice outranks any token's second choice."""
+        params, x = self._setup(e=2, s=6)
+        params = dict(params)
+        # All tokens: first choice expert 0, second choice expert 1.
+        params["router"] = jnp.asarray([[5.0, 1.0]] * x.shape[-1],
+                                       jnp.float32) * 0.0
+        params["router"] = params["router"].at[0, 0].set(5.0)
+        params["router"] = params["router"].at[0, 1].set(1.0)
+        x = x.at[..., 0].set(1.0)
+        # capacity = ceil(2*6/2 * 0.5) = 3 < 6 tokens: expert 0's buffer
+        # fills with first choices only.
+        y, _ = moe_ffn(x, params, 2, capacity_factor=0.5, top_k=2)
+        assert np.isfinite(np.asarray(y)).all()
+
+    def test_topk_out_of_range(self):
+        params, x = self._setup()
+        with pytest.raises(ValueError, match="top_k"):
+            moe_ffn(x, params, 4, top_k=5)
+        with pytest.raises(ValueError, match="top_k"):
+            moe_ffn(x, params, 4, top_k=0)
+
+    def test_top2_differentiable_and_trains_sharded(self):
+        """Full top-2 train step on the dp x ep mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cfg = TransformerConfig(vocab=64, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=32, max_seq=32,
+                                n_experts=4, moe_top_k=2)
+        mesh = _ep_mesh()
+        init_state, step = make_train_step(cfg, mesh=mesh)
+        state = init_state(jax.random.PRNGKey(0))
+        tokens = jax.device_put(
+            jnp.asarray(np.random.default_rng(0).integers(
+                0, cfg.vocab, (4, 17)), dtype=jnp.int32),
+            NamedSharding(mesh, P("dp", None)))
+        state, loss1 = step(state, tokens)
+        state, loss2 = step(state, tokens)
+        assert np.isfinite(float(loss1))
+        assert float(loss2) < float(loss1) + 1.0

@@ -381,3 +381,72 @@ class TestCancelReceive:
         net = TcpNetwork()
         with pytest.raises(MpiError, match="before init"):
             net.send(b"x", 0, 0)
+
+
+class TestProtocols:
+    """-mpi-protocol is honored: unix-domain sockets work end to end,
+    anything unsupported raises loudly (VERDICT round-1 item 9;
+    reference: NetProto accepts net-package protocols, network.go:26)."""
+
+    def test_unix_socket_cluster(self, tmp_path):
+        import threading as _threading
+
+        from mpi_tpu import collectives_generic as G
+        from mpi_tpu.backends.tcp import TcpNetwork
+
+        addrs = sorted(str(tmp_path / f"rank{i}.sock") for i in range(3))
+        nets = [TcpNetwork(proto="unix", addr=a, addrs=list(addrs),
+                           timeout=20.0) for a in addrs]
+        errs = [None] * 3
+
+        def _init(i):
+            try:
+                nets[i].init()
+            except BaseException as exc:  # noqa: BLE001
+                errs[i] = exc
+
+        threads = [_threading.Thread(target=_init, args=(i,), daemon=True)
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert all(e is None for e in errs), errs
+        nets_by_rank = sorted(nets, key=lambda m: m.rank())
+        try:
+            def prog(net, r):
+                import numpy as _np
+
+                if r == 0:
+                    net.send(b"over-unix", 1, 7)
+                elif r == 1:
+                    assert net.receive(0, 7) == b"over-unix"
+                return G.allreduce(net, _np.float32(r + 1))
+
+            totals = run_on_ranks(nets_by_rank, prog)
+            assert all(float(t) == 6.0 for t in totals)
+        finally:
+            for m in nets_by_rank:
+                m.finalize()
+        # Socket files are cleaned up on finalize.
+        assert not any((tmp_path / f"rank{i}.sock").exists()
+                       for i in range(3))
+
+    def test_unsupported_protocol_raises(self):
+        from mpi_tpu.backends.tcp import InitError, TcpNetwork
+
+        net = TcpNetwork(proto="sctp", addr=":1", addrs=[":1"])
+        with pytest.raises(InitError, match="unsupported -mpi-protocol"):
+            net.init()
+
+    def test_tcp4_alias_still_works(self):
+        with tcp_cluster(2) as nets:
+            for n in nets:
+                assert n.proto == "tcp"
+        # explicit tcp4 single-node init
+        from mpi_tpu.backends.tcp import TcpNetwork
+
+        net = TcpNetwork(proto="tcp4", addr=":0", addrs=[":0"])
+        net.init()
+        assert net.size() == 1
+        net.finalize()

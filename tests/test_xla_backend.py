@@ -457,3 +457,172 @@ class TestCompiledAllgather:
         for per_rank in results:
             assert per_rank == [0, 10, 20, 30]
             assert all(isinstance(v, int) for v in per_rank)
+
+
+class TestCompiledCollectivePaths:
+    """VERDICT round-1 item 3: bcast / scatter / gather / alltoall /
+    reduce_scatter run as single compiled XLA programs for uniform array
+    payloads (the object fallback keeps working), and results agree with
+    the generic oracle."""
+
+    def _run(self, fn, net=None):
+        net = net or XlaNetwork(n=N)
+        out = run_spmd(fn, net=net)
+        return out, net
+
+    def test_bcast_array_compiled(self):
+        data = np.arange(24, dtype=np.float32).reshape(4, 6)
+
+        def main():
+            mpi_tpu.init()
+            payload = data + 1 if mpi_tpu.rank() == 2 else None
+            got = mpi_tpu.bcast(payload, root=2)
+            mpi_tpu.finalize()
+            return np.asarray(got)
+
+        out, net = self._run(main)
+        for o in out:
+            np.testing.assert_array_equal(o, data + 1)
+        assert ("bcast", "", False, 2) in net._jit_cache
+
+    def test_scatter_array_compiled(self):
+        def main():
+            mpi_tpu.init()
+            items = None
+            if mpi_tpu.rank() == 0:
+                items = [np.full((3,), float(i), np.float32)
+                         for i in range(N)]
+            got = mpi_tpu.scatter(items, root=0)
+            mpi_tpu.finalize()
+            return np.asarray(got)
+
+        out, _ = self._run(main)
+        for i, o in enumerate(out):
+            np.testing.assert_array_equal(o, np.full((3,), float(i)))
+
+    def test_gather_array_compiled(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            got = mpi_tpu.gather(
+                np.full((2, 2), float(r), np.float32), root=3)
+            mpi_tpu.finalize()
+            return got
+
+        out, net = self._run(main)
+        assert out[3] is not None and len(out[3]) == N
+        for i, row in enumerate(out[3]):
+            np.testing.assert_array_equal(row, np.full((2, 2), float(i)))
+        assert all(out[i] is None for i in range(N) if i != 3)
+        assert ("allgather", "", False) in net._jit_cache
+
+    def test_alltoall_array_compiled(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            payloads = [np.asarray([r * 10 + j], np.int32)
+                        for j in range(N)]
+            got = mpi_tpu.alltoall(payloads)
+            mpi_tpu.finalize()
+            return [int(np.asarray(g)[0]) for g in got]
+
+        out, net = self._run(main)
+        for dst in range(N):
+            assert out[dst] == [src * 10 + dst for src in range(N)]
+        assert ("alltoall", "", False) in net._jit_cache
+
+    def test_alltoall_object_fallback(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            got = mpi_tpu.alltoall([f"{r}->{j}" for j in range(N)])
+            mpi_tpu.finalize()
+            return got
+
+        out, _ = self._run(main)
+        for dst in range(N):
+            assert out[dst] == [f"{src}->{dst}" for src in range(N)]
+
+    def test_reduce_scatter_matches_generic(self):
+        rng = np.random.default_rng(5)
+        contribs = rng.standard_normal((N, 16)).astype(np.float32)
+
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            got = mpi_tpu.reduce_scatter(contribs[r])
+            mpi_tpu.finalize()
+            return np.asarray(got)
+
+        out, net = self._run(main, XlaNetwork(
+            n=N, deterministic_collectives=True))
+        total = contribs.sum(axis=0)
+        for i, o in enumerate(out):
+            assert o.shape == (2,)
+            np.testing.assert_allclose(o, total[i * 2:(i + 1) * 2],
+                                       rtol=1e-5)
+        assert ("reduce_scatter", "sum", True) in net._jit_cache
+
+    def test_reduce_scatter_bitwise_vs_tcp(self):
+        """Deterministic XLA reduce_scatter == generic tree order over the
+        TCP driver, bit for bit (the north-star parity contract)."""
+        rng = np.random.default_rng(11)
+        contribs = rng.standard_normal((4, 8)).astype(np.float32)
+
+        def xla_main():
+            mpi_tpu.init()
+            got = mpi_tpu.reduce_scatter(contribs[mpi_tpu.rank()])
+            mpi_tpu.finalize()
+            return np.asarray(got)
+
+        xla_out = run_spmd(
+            xla_main, net=XlaNetwork(n=4, deterministic_collectives=True))
+
+        from mpi_tpu import collectives_generic as G
+
+        with tcp_cluster(4) as nets:
+            tcp_out = run_on_ranks(
+                nets, lambda net, r: G.reduce_scatter(net, contribs[r]))
+        for a, b in zip(xla_out, tcp_out):
+            np.testing.assert_array_equal(a, np.asarray(b))
+
+    def test_reduce_scatter_indivisible_raises_everywhere(self):
+        def main():
+            mpi_tpu.init()
+            try:
+                with pytest.raises(mpi_tpu.MpiError, match="divide"):
+                    mpi_tpu.reduce_scatter(np.ones((N + 1,), np.float32))
+            finally:
+                mpi_tpu.finalize()
+
+        self._run(main)
+
+    def test_config4_mixed_dtype_ring_suite(self):
+        """BASELINE.json config 4: Bcast + Allgather, mixed int64/float64
+        payloads, all on compiled collective paths (x64 is enabled in
+        tests, so 64-bit dtypes are canonical)."""
+        i64 = np.arange(8, dtype=np.int64)
+        f64 = np.linspace(0, 1, 8)
+
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            got_i = mpi_tpu.bcast(i64 if r == 0 else None, root=0)
+            got_f = mpi_tpu.bcast(f64 * 2 if r == 1 else None, root=1)
+            rows_i = mpi_tpu.allgather(i64 + r)
+            rows_f = mpi_tpu.allgather(f64 + r)
+            mpi_tpu.finalize()
+            return got_i, got_f, rows_i, rows_f
+
+        out, net = self._run(main)
+        for got_i, got_f, rows_i, rows_f in out:
+            assert np.asarray(got_i).dtype == np.int64
+            assert np.asarray(got_f).dtype == np.float64
+            np.testing.assert_array_equal(got_i, i64)
+            np.testing.assert_array_equal(got_f, f64 * 2)
+            for r in range(N):
+                np.testing.assert_array_equal(rows_i[r], i64 + r)
+                np.testing.assert_allclose(rows_f[r], f64 + r)
+        assert ("bcast", "", False, 0) in net._jit_cache
+        assert ("bcast", "", False, 1) in net._jit_cache
+        assert ("allgather", "", False) in net._jit_cache
