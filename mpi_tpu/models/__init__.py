@@ -16,6 +16,7 @@ from .transformer import (
     forward_with_aux,
     param_specs,
     sanitize_spec,
+    make_train_parts,
     make_train_step,
     make_mesh_nd,
 )
@@ -29,6 +30,7 @@ __all__ = [
     "forward_with_aux",
     "param_specs",
     "sanitize_spec",
+    "make_train_parts",
     "make_train_step",
     "make_mesh_nd",
     "init_moe_params",

@@ -42,6 +42,7 @@ __all__ = [
     "forward_with_aux",
     "param_specs",
     "sanitize_spec",
+    "make_train_parts",
     "make_train_step",
     "make_mesh_nd",
 ]
@@ -298,12 +299,12 @@ def loss_fn(params, tokens, cfg: TransformerConfig,
 # Training step
 # --------------------------------------------------------------------------
 
-def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
-                    learning_rate: float = 1e-3):
-    """Build (init_state, step). ``step(state, tokens) -> (state, loss)``
-    is one fully jitted optimizer step; with a mesh, params/opt-state are
-    committed to :func:`param_specs` shardings and the batch to
-    ``P('dp', 'sp')`` so GSPMD inserts the dp grad-psum and tp reductions."""
+def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
+                     learning_rate: float = 1e-3):
+    """Build (init_state, step_body) with ``step_body`` left un-jitted —
+    for callers that embed the step in a larger program (the bench
+    harness scans it; :func:`make_train_step` jits it as-is). Both
+    callers therefore run the *same* optimizer step by construction."""
     import optax
 
     opt = optax.adamw(learning_rate)
@@ -331,6 +332,17 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
         new_params = optax.apply_updates(state["params"], updates)
         return {"params": new_params, "opt": new_opt}, loss
 
+    return init_state, step
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
+                    learning_rate: float = 1e-3):
+    """Build (init_state, step). ``step(state, tokens) -> (state, loss)``
+    is one fully jitted optimizer step; with a mesh, params/opt-state are
+    committed to :func:`param_specs` shardings and the batch to
+    ``P('dp', 'sp')`` so GSPMD inserts the dp grad-psum and tp reductions."""
+    init_state, step = make_train_parts(cfg, mesh=mesh,
+                                        learning_rate=learning_rate)
     return init_state, jax.jit(step)
 
 
