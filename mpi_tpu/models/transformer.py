@@ -60,9 +60,11 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32    # master params
     # "dense" | "flash" (Pallas kernel, mpi_tpu.ops) | "blockwise"
     # (checkpointed scan) | "ring" (kv ring over the sp axis,
-    # parallel.ring_attention) | "zigzag" (ring with the work-balanced
-    # zigzag causal layout) | "ulysses" (all-to-all head/seq reshard,
-    # parallel.ulysses). ring/zigzag/ulysses need a mesh with 'sp'.
+    # parallel.ring_attention) | "ring_flash" (same ring, Pallas flash
+    # kernel per chunk with the FA-2 Pallas backward) | "zigzag" (ring
+    # with the work-balanced zigzag causal layout) | "ulysses"
+    # (all-to-all head/seq reshard, parallel.ulysses).
+    # ring/ring_flash/zigzag/ulysses need a mesh with 'sp'.
     attention_impl: str = "dense"
     # Mixture-of-Experts FFN (0 = dense). Experts shard over the 'ep'
     # mesh axis (mpi_tpu.models.moe); aux load-balance loss is added to
@@ -182,15 +184,16 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
         from ..ops import blockwise_attention
 
         ctx = blockwise_attention(q, k, v)
-    elif impl in ("ring", "zigzag"):
+    elif impl in ("ring", "zigzag", "ring_flash"):
         from ..parallel.ring_attention import ring_attention_sharded
 
         if mesh is None:
             raise ValueError(
                 f"attention_impl={impl!r} needs a mesh with an 'sp' axis")
         layout = "zigzag" if impl == "zigzag" else "contiguous"
+        chunk = "flash" if impl == "ring_flash" else "fold"
         ctx = ring_attention_sharded(q, k, v, mesh, axis_name="sp",
-                                     layout=layout)
+                                     layout=layout, chunk_impl=chunk)
     elif impl == "ulysses":
         from ..parallel.ulysses import ulysses_attention_sharded
 
@@ -205,7 +208,7 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     else:
         raise ValueError(
             f"unknown attention_impl {impl!r}: expected dense|flash|"
-            f"blockwise|ring|zigzag|ulysses")
+            f"blockwise|ring|ring_flash|zigzag|ulysses")
     return jnp.einsum("bshk,hkd->bsd", ctx, blk["wo"].astype(x.dtype))
 
 

@@ -36,7 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["dense_attention", "blockwise_attention", "flash_attention",
-           "online_softmax_fold", "NEG_INF"]
+           "flash_attention_with_lse", "flash_chunk_bwd",
+           "merge_attention_chunks", "online_softmax_fold", "NEG_INF"]
 
 NEG_INF = -1e30  # finite mask value: keeps exp() well-defined everywhere
 _NEG_INF = NEG_INF
@@ -456,6 +457,52 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
 
     unflat = lambda x, n: x.reshape(b, h, n, d).transpose(0, 2, 1, 3)  # noqa: E731
     return unflat(dq, s), unflat(dk, t), unflat(dv, t)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             block_q: int = 256, block_k: int = 512,
+                             interpret: Optional[bool] = None):
+    """Forward flash attention that also returns the per-row log-sum-exp.
+
+    ``(out, lse)`` with ``out`` shaped like ``q`` and ``lse`` ``(b, h, s)``
+    float32. Attention over a *subset* of keys composes exactly from
+    (out, lse) pairs (:func:`merge_attention_chunks`) — the primitive ring
+    attention builds on: each ring step runs this kernel on the visiting
+    kv chunk and merges. Forward-only (no vjp is registered here); ring
+    attention supplies its own backward via :func:`flash_chunk_bwd`."""
+    itp = _should_interpret() if interpret is None else interpret
+    b, s, h, d = q.shape
+    out, lse = _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k, itp)
+    return out, lse.reshape(b, h, s)
+
+
+def merge_attention_chunks(o1, lse1, o2, lse2):
+    """Combine two attention results over disjoint key sets.
+
+    ``o``: (b, s, h, d) normalized outputs; ``lse``: (b, h, s) float32.
+    Returns the merged (o, lse). Rows that attended nothing anywhere
+    (lse ~ NEG_INF on both sides) stay zero, matching the masked-fold
+    convention."""
+    lse_m = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse_m).transpose(0, 2, 1)[..., None]
+    w2 = jnp.exp(lse2 - lse_m).transpose(0, 2, 1)[..., None]
+    o = o1.astype(jnp.float32) * w1 + o2.astype(jnp.float32) * w2
+    return o.astype(o1.dtype), lse_m
+
+
+def flash_chunk_bwd(q, k, v, out, lse, g, causal: bool = False,
+                    block_q: int = 256, block_k: int = 512,
+                    interpret: Optional[bool] = None):
+    """FA-2 backward for ONE (query-chunk, kv-chunk) pair against the
+    *global* softmax: ``out``/``lse`` are the full-attention result rows
+    (after every chunk was merged), so the rebuilt probabilities
+    ``exp(qk - lse)`` are the true global ones and the returned
+    ``(dq, dk, dv)`` are this pair's exact additive contributions. Ring
+    attention calls this once per ring step."""
+    itp = _should_interpret() if interpret is None else interpret
+    b, s, h, _ = q.shape
+    return _flash_bwd_pallas(q, k, v, out, lse.reshape(b * h, 1, s), g,
+                             causal, block_q, block_k, itp)
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):

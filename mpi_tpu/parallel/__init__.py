@@ -19,6 +19,7 @@ from .collectives import (
 )
 from .ring_attention import (
     ring_attention,
+    ring_flash_attention,
     ring_attention_sharded,
     ring_attention_zigzag,
     zigzag_indices,
@@ -32,6 +33,7 @@ __all__ = [
     "mesh_devices",
     "rank_axis",
     "ring_attention",
+    "ring_flash_attention",
     "ring_attention_sharded",
     "ring_attention_zigzag",
     "zigzag_indices",

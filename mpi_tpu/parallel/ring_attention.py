@@ -14,14 +14,21 @@ SURVEY.md §5 "long-context: not applicable"); ring attention is the
 rebuild's showcase of the same ICI neighbour-transfer pattern its
 Send/Receive would express, fused into a compiled program.
 
-Two entry points:
+Entry points:
 
   * :func:`ring_attention` — call *inside* ``shard_map``/``pmap`` tracing
     over the sequence axis; per-device shards shaped
-    ``(batch, seq_local, heads, head_dim)``;
+    ``(batch, seq_local, heads, head_dim)``; einsum online-softmax fold
+    per chunk;
+  * :func:`ring_flash_attention` — same ring, but each chunk runs the
+    Pallas flash kernel (MXU tiles in VMEM) and chunk results merge via
+    their log-sum-exp rows; backward is the FlashAttention-2 Pallas
+    backward per chunk pair, with dk/dv accumulating on the chunks as
+    they travel the ring;
   * :func:`ring_attention_sharded` — wrapper that applies ``shard_map``
-    over a :class:`jax.sharding.Mesh` for use under plain ``jit`` (this is
-    what ``TransformerConfig(attention_impl="ring")`` uses).
+    over a :class:`jax.sharding.Mesh` for use under plain ``jit`` (what
+    ``TransformerConfig(attention_impl="ring"/"ring_flash")`` uses;
+    ``chunk_impl`` selects fold vs flash).
 
 Two sequence layouts:
 
@@ -50,11 +57,13 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import NEG_INF, online_softmax_fold
+from ..ops.attention import (NEG_INF, flash_attention_with_lse,
+                             flash_chunk_bwd, merge_attention_chunks,
+                             online_softmax_fold)
 
-__all__ = ["ring_attention", "ring_attention_sharded",
-           "ring_attention_zigzag", "zigzag_indices",
-           "zigzag_inverse_indices"]
+__all__ = ["ring_attention", "ring_flash_attention",
+           "ring_attention_sharded", "ring_attention_zigzag",
+           "zigzag_indices", "zigzag_inverse_indices"]
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -112,6 +121,112 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# Ring attention with Pallas flash chunks (fwd + FA-2 bwd)
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str = "sp", causal: bool = True,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    """Ring attention whose per-chunk compute is the Pallas flash kernel.
+
+    Same semantics and layout as :func:`ring_attention` (contiguous
+    shards, kv rotates over ``axis_name``), but each ring step runs
+    :func:`mpi_tpu.ops.flash_attention_with_lse` on the visiting chunk —
+    MXU-tiled VMEM-resident work instead of a materialised (s_local x
+    s_local) einsum fold — and chunk results merge through their
+    log-sum-exp rows (:func:`mpi_tpu.ops.merge_attention_chunks`).
+
+    Differentiable: the backward re-rotates kv around the ring and calls
+    the FlashAttention-2 Pallas backward per chunk pair against the saved
+    *global* (out, lse), so dk/dv accumulate on the chunks as they travel
+    and arrive home after a full loop. Per-device residual memory is
+    O(s_local·d) — no O(s²) anywhere.
+    """
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    perm = [(r, (r + 1) % n) for r in range(n)]
+
+    kc, vc = k, v
+    # Step 0: the resident (diagonal) chunk — causal within the chunk.
+    # The running output stays float32 across the whole ring (one cast at
+    # the end): re-quantizing to bf16 at every merge would compound
+    # rounding error n-1 times, unlike the fold path's single cast.
+    out, lse = flash_attention_with_lse(q, kc, vc, causal=causal,
+                                        interpret=interpret)
+    out = out.astype(jnp.float32)
+    for step in range(1, n):
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        src = (me - step) % n
+
+        def fold_in(args, kc=kc, vc=vc):
+            o, l = args
+            oc, lc = flash_attention_with_lse(q, kc, vc, causal=False,
+                                              interpret=interpret)
+            return merge_attention_chunks(o, l, oc, lc)
+
+        if causal:
+            # Future chunks (src > me) are fully masked: skip the kernel.
+            out, lse = lax.cond(src > me, lambda a: a, fold_in, (out, lse))
+        else:
+            out, lse = fold_in((out, lse))
+    # Primal in q's dtype; the float32 (out, lse) pair stays in the
+    # residuals so the backward's delta is computed at full precision.
+    return out.astype(q.dtype), (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, interpret, res, g):
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    perm = [(r, (r + 1) % n) for r in range(n)]
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    # dk/dv accumulators travel WITH their kv chunks around the ring and
+    # are home (at the owning device) after the final hop.
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    kc, vc = k, v
+
+    for step in range(n):
+        src = (me - step) % n
+        if step > 0:
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            dk = lax.ppermute(dk, axis_name, perm)
+            dv = lax.ppermute(dv, axis_name, perm)
+
+        def contrib(args, kc=kc, vc=vc, is_self=(step == 0)):
+            dq_, dk_, dv_ = args
+            dql, dkl, dvl = flash_chunk_bwd(
+                q, kc, vc, out, lse, g,
+                causal=causal and is_self, interpret=interpret)
+            return (dq_ + dql.astype(jnp.float32),
+                    dk_ + dkl.astype(jnp.float32),
+                    dv_ + dvl.astype(jnp.float32))
+
+        if causal and step > 0:
+            dq, dk, dv = lax.cond(src > me, lambda a: a, contrib,
+                                  (dq, dk, dv))
+        else:
+            dq, dk, dv = contrib((dq, dk, dv))
+
+    # Final hop returns each chunk's accumulated dk/dv to its owner.
+    dk = lax.ppermute(dk, axis_name, perm)
+    dv = lax.ppermute(dv, axis_name, perm)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 # --------------------------------------------------------------------------
@@ -223,7 +338,8 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            causal: bool = True,
                            batch_axis: Optional[str] = "dp",
                            head_axis: Optional[str] = "tp",
-                           layout: str = "contiguous") -> jax.Array:
+                           layout: str = "contiguous",
+                           chunk_impl: str = "fold") -> jax.Array:
     """shard_map wrapper: global ``(b, s, h, d)`` arrays in, ring over the
     sequence axis, global arrays out. Batch/head axes shard over
     ``dp``/``tp`` when the mesh has them (pass None to replicate).
@@ -232,11 +348,20 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     work-balanced zigzag order, runs :func:`ring_attention_zigzag`, and
     permutes back — callers that keep activations zigzag-ordered
     end-to-end can instead pre-permute once and call with the body
-    directly."""
+    directly.
+
+    ``chunk_impl`` selects the per-chunk math for the contiguous layout:
+    ``"fold"`` (einsum online-softmax, runs anywhere) or ``"flash"``
+    (:func:`ring_flash_attention` — Pallas kernel per chunk, FA-2 Pallas
+    backward; interpreter mode off-TPU)."""
     names = mesh.axis_names
     if axis_name not in names:
         raise ValueError(
             f"mesh {names} has no {axis_name!r} axis for ring attention")
+    if chunk_impl not in ("fold", "flash"):
+        raise ValueError(
+            f"mpi_tpu: unknown ring chunk_impl {chunk_impl!r}: "
+            f"expected fold|flash")
     spec = P(batch_axis if batch_axis in names else None,
              axis_name if axis_name in names else None,
              head_axis if head_axis in names else None,
@@ -246,6 +371,11 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
             raise ValueError(
                 "mpi_tpu: zigzag layout only applies to causal attention "
                 "(non-causal work is already balanced)")
+        if chunk_impl != "fold":
+            raise ValueError(
+                "mpi_tpu: zigzag currently folds chunks with the einsum "
+                "recurrence; use layout='contiguous' for chunk_impl="
+                "'flash'")
         n = mesh.shape[axis_name]
         s = q.shape[1]
         fwd = jnp.asarray(zigzag_indices(n, s))
@@ -260,8 +390,12 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(
             f"mpi_tpu: unknown ring layout {layout!r}: "
             f"expected contiguous|zigzag")
-    body = functools.partial(ring_attention, axis_name=axis_name,
-                             causal=causal)
+    if chunk_impl == "flash":
+        body = functools.partial(ring_flash_attention, axis_name=axis_name,
+                                 causal=causal)
+    else:
+        body = functools.partial(ring_attention, axis_name=axis_name,
+                                 causal=causal)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)
