@@ -62,9 +62,10 @@ class TransformerConfig:
     # (checkpointed scan) | "ring" (kv ring over the sp axis,
     # parallel.ring_attention) | "ring_flash" (same ring, Pallas flash
     # kernel per chunk with the FA-2 Pallas backward) | "zigzag" (ring
-    # with the work-balanced zigzag causal layout) | "ulysses"
-    # (all-to-all head/seq reshard, parallel.ulysses).
-    # ring/ring_flash/zigzag/ulysses need a mesh with 'sp'.
+    # with the work-balanced zigzag causal layout) | "zigzag_flash"
+    # (zigzag layout + flash chunks) | "ulysses" (all-to-all head/seq
+    # reshard, parallel.ulysses). The ring/zigzag/ulysses family needs
+    # a mesh with 'sp'.
     attention_impl: str = "dense"
     # Mixture-of-Experts FFN (0 = dense). Experts shard over the 'ep'
     # mesh axis (mpi_tpu.models.moe); aux load-balance loss is added to
@@ -184,14 +185,14 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
         from ..ops import blockwise_attention
 
         ctx = blockwise_attention(q, k, v)
-    elif impl in ("ring", "zigzag", "ring_flash"):
+    elif impl in ("ring", "zigzag", "ring_flash", "zigzag_flash"):
         from ..parallel.ring_attention import ring_attention_sharded
 
         if mesh is None:
             raise ValueError(
                 f"attention_impl={impl!r} needs a mesh with an 'sp' axis")
-        layout = "zigzag" if impl == "zigzag" else "contiguous"
-        chunk = "flash" if impl == "ring_flash" else "fold"
+        layout = "zigzag" if impl.startswith("zigzag") else "contiguous"
+        chunk = "flash" if impl.endswith("_flash") else "fold"
         ctx = ring_attention_sharded(q, k, v, mesh, axis_name="sp",
                                      layout=layout, chunk_impl=chunk)
     elif impl == "ulysses":
@@ -208,7 +209,7 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     else:
         raise ValueError(
             f"unknown attention_impl {impl!r}: expected dense|flash|"
-            f"blockwise|ring|ring_flash|zigzag|ulysses")
+            f"blockwise|ring|ring_flash|zigzag|zigzag_flash|ulysses")
     return jnp.einsum("bshk,hkd->bsd", ctx, blk["wo"].astype(x.dtype))
 
 

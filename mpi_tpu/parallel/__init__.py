@@ -20,6 +20,7 @@ from .collectives import (
 from .ring_attention import (
     ring_attention,
     ring_flash_attention,
+    ring_flash_attention_zigzag,
     ring_attention_sharded,
     ring_attention_zigzag,
     zigzag_indices,
@@ -34,6 +35,7 @@ __all__ = [
     "rank_axis",
     "ring_attention",
     "ring_flash_attention",
+    "ring_flash_attention_zigzag",
     "ring_attention_sharded",
     "ring_attention_zigzag",
     "zigzag_indices",

@@ -62,8 +62,9 @@ from ..ops.attention import (NEG_INF, flash_attention_with_lse,
                              online_softmax_fold)
 
 __all__ = ["ring_attention", "ring_flash_attention",
-           "ring_attention_sharded", "ring_attention_zigzag",
-           "zigzag_indices", "zigzag_inverse_indices"]
+           "ring_flash_attention_zigzag", "ring_attention_sharded",
+           "ring_attention_zigzag", "zigzag_indices",
+           "zigzag_inverse_indices"]
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -230,6 +231,155 @@ ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 # --------------------------------------------------------------------------
+# Zigzag layout with Pallas flash chunks
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_flash_attention_zigzag(q: jax.Array, k: jax.Array, v: jax.Array,
+                                axis_name: str = "sp",
+                                interpret: Optional[bool] = None
+                                ) -> jax.Array:
+    """Causal zigzag ring attention with Pallas flash chunks.
+
+    Combines :func:`ring_attention_zigzag`'s balanced layout (shard =
+    one early + one late chunk, so every ring step is the same work on
+    every device) with :func:`ring_flash_attention`'s per-chunk kernel
+    math. The case split per step (see :func:`ring_attention_zigzag`)
+    maps onto plain causal/full kernel calls on chunk slices:
+
+      * self step — three sub-blocks: early×early (causal kernel),
+        late×late (causal kernel), late×early (full kernel);
+      * visiting chunk from ``src < me`` — all queries × kv early half,
+        full kernel;
+      * ``src > me`` — late queries × both kv halves, full kernel.
+
+    Backward mirrors the split with :func:`mpi_tpu.ops.flash_chunk_bwd`
+    per sub-pair; dk/dv accumulate on the travelling chunks.
+    """
+    out, _ = _ring_flash_zz_fwd(q, k, v, axis_name, interpret)
+    return out
+
+
+def _zz_merge_slice(out, lse, oc, lc, lo: int):
+    """Merge a chunk result computed for query slice [lo:lo+len] into the
+    running float32 (out, lse) state."""
+    hi = lo + oc.shape[1]
+    o_m, l_m = merge_attention_chunks(out[:, lo:hi], lse[:, :, lo:hi],
+                                      oc, lc)
+    return out.at[:, lo:hi].set(o_m), lse.at[:, :, lo:hi].set(l_m)
+
+
+def _ring_flash_zz_fwd(q, k, v, axis_name, interpret):
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    perm = [(r, (r + 1) % n) for r in range(n)]
+    b, s_local, h, d = q.shape
+    if s_local % 2:
+        raise ValueError("zigzag shards must have even local length")
+    c = s_local // 2
+    kc, vc = k, v
+
+    # Self step: early×early and late×late are plain causal kernels;
+    # late×early is a full kernel (the early chunk is wholly in the late
+    # chunk's past).
+    o_e, l_e = flash_attention_with_lse(q[:, :c], kc[:, :c], vc[:, :c],
+                                        causal=True, interpret=interpret)
+    o_l, l_l = flash_attention_with_lse(q[:, c:], kc[:, c:], vc[:, c:],
+                                        causal=True, interpret=interpret)
+    out = jnp.concatenate([o_e, o_l], axis=1).astype(jnp.float32)
+    lse = jnp.concatenate([l_e, l_l], axis=2)
+    o_le, l_le = flash_attention_with_lse(q[:, c:], kc[:, :c], vc[:, :c],
+                                          causal=False, interpret=interpret)
+    out, lse = _zz_merge_slice(out, lse, o_le, l_le, c)
+
+    for step in range(1, n):
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        src = (me - step) % n
+
+        def past_case(args, kc=kc, vc=vc):
+            # src < me: every query attends the visiting early chunk.
+            o, l = args
+            oc, lc = flash_attention_with_lse(
+                q, kc[:, :c], vc[:, :c], causal=False, interpret=interpret)
+            return _zz_merge_slice(o, l, oc, lc, 0)
+
+        def future_case(args, kc=kc, vc=vc):
+            # src > me: late queries attend both visiting chunks.
+            o, l = args
+            oc, lc = flash_attention_with_lse(
+                q[:, c:], kc, vc, causal=False, interpret=interpret)
+            return _zz_merge_slice(o, l, oc, lc, c)
+
+        out, lse = lax.cond(src < me, past_case, future_case, (out, lse))
+
+    return out.astype(q.dtype), (q, k, v, out, lse)
+
+
+def _ring_flash_zz_bwd(axis_name, interpret, res, g):
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    perm = [(r, (r + 1) % n) for r in range(n)]
+    c = q.shape[1] // 2
+
+    f32 = jnp.float32
+    dq = jnp.zeros(q.shape, f32)
+    dk = jnp.zeros(k.shape, f32)
+    dv = jnp.zeros(v.shape, f32)
+    kc, vc = k, v
+
+    def pair(qs, ks, vs, os, ls, gs, causal):
+        return flash_chunk_bwd(qs, ks, vs, os, ls, gs, causal=causal,
+                               interpret=interpret)
+
+    # Self step — the forward's three sub-pairs as (q_lo, kv_lo, causal):
+    # early×early causal, late×late causal, late×early full.
+    for q_lo, kv_lo, causal in ((0, 0, True), (c, c, True), (c, 0, False)):
+        q_hi, kv_hi = q_lo + c, kv_lo + c
+        dql, dkl, dvl = pair(
+            q[:, q_lo:q_hi], kc[:, kv_lo:kv_hi], vc[:, kv_lo:kv_hi],
+            out[:, q_lo:q_hi], lse[:, :, q_lo:q_hi], g[:, q_lo:q_hi],
+            causal)
+        dq = dq.at[:, q_lo:q_hi].add(dql.astype(f32))
+        dk = dk.at[:, kv_lo:kv_hi].add(dkl.astype(f32))
+        dv = dv.at[:, kv_lo:kv_hi].add(dvl.astype(f32))
+
+    for step in range(1, n):
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        src = (me - step) % n
+
+        def past_case(args, kc=kc, vc=vc):
+            dq_, dk_, dv_ = args
+            dql, dkl, dvl = pair(q, kc[:, :c], vc[:, :c], out, lse, g,
+                                 False)
+            return (dq_ + dql.astype(f32),
+                    dk_.at[:, :c].add(dkl.astype(f32)),
+                    dv_.at[:, :c].add(dvl.astype(f32)))
+
+        def future_case(args, kc=kc, vc=vc):
+            dq_, dk_, dv_ = args
+            dql, dkl, dvl = pair(q[:, c:], kc, vc, out[:, c:],
+                                 lse[:, :, c:], g[:, c:], False)
+            return (dq_.at[:, c:].add(dql.astype(f32)),
+                    dk_ + dkl.astype(f32), dv_ + dvl.astype(f32))
+
+        dq, dk, dv = lax.cond(src < me, past_case, future_case,
+                              (dq, dk, dv))
+
+    # Final hop: each chunk's accumulated dk/dv returns to its owner.
+    dk = lax.ppermute(dk, axis_name, perm)
+    dv = lax.ppermute(dv, axis_name, perm)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+ring_flash_attention_zigzag.defvjp(_ring_flash_zz_fwd, _ring_flash_zz_bwd)
+
+
+# --------------------------------------------------------------------------
 # Zigzag layout
 # --------------------------------------------------------------------------
 
@@ -350,10 +500,11 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     end-to-end can instead pre-permute once and call with the body
     directly.
 
-    ``chunk_impl`` selects the per-chunk math for the contiguous layout:
+    ``chunk_impl`` selects the per-chunk math for either layout:
     ``"fold"`` (einsum online-softmax, runs anywhere) or ``"flash"``
-    (:func:`ring_flash_attention` — Pallas kernel per chunk, FA-2 Pallas
-    backward; interpreter mode off-TPU)."""
+    (:func:`ring_flash_attention` / :func:`ring_flash_attention_zigzag`
+    — Pallas kernel per chunk, FA-2 Pallas backward; interpreter mode
+    off-TPU)."""
     names = mesh.axis_names
     if axis_name not in names:
         raise ValueError(
@@ -371,16 +522,16 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
             raise ValueError(
                 "mpi_tpu: zigzag layout only applies to causal attention "
                 "(non-causal work is already balanced)")
-        if chunk_impl != "fold":
-            raise ValueError(
-                "mpi_tpu: zigzag currently folds chunks with the einsum "
-                "recurrence; use layout='contiguous' for chunk_impl="
-                "'flash'")
         n = mesh.shape[axis_name]
         s = q.shape[1]
         fwd = jnp.asarray(zigzag_indices(n, s))
         inv = jnp.asarray(zigzag_inverse_indices(n, s))
-        body = functools.partial(ring_attention_zigzag, axis_name=axis_name)
+        if chunk_impl == "flash":
+            body = functools.partial(ring_flash_attention_zigzag,
+                                     axis_name=axis_name)
+        else:
+            body = functools.partial(ring_attention_zigzag,
+                                     axis_name=axis_name)
         fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                            out_specs=spec, check_vma=False)
         out = fn(jnp.take(q, fwd, axis=1), jnp.take(k, fwd, axis=1),

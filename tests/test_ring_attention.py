@@ -243,14 +243,15 @@ def test_ring_flash_grads_match_dense(causal):
         np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
 
 
-def test_ring_flash_in_flagship_train_step():
-    """attention_impl='ring_flash' trains end-to-end on a dp x sp mesh."""
+@pytest.mark.parametrize("impl", ["ring_flash", "zigzag_flash"])
+def test_flash_ring_impls_in_flagship_train_step(impl):
+    """Both flash-chunk ring variants train end-to-end on a dp x sp
+    mesh."""
     from mpi_tpu.models import TransformerConfig, make_train_step
 
     mesh = _mesh(("dp", "sp"), (2, 2))
     cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
-                            d_ff=64, max_seq=32,
-                            attention_impl="ring_flash")
+                            d_ff=64, max_seq=32, attention_impl=impl)
     init_state, step = make_train_step(cfg, mesh=mesh)
     state = init_state(jax.random.PRNGKey(0))
     tokens = jnp.asarray(
@@ -262,10 +263,38 @@ def test_ring_flash_in_flagship_train_step():
     assert np.isfinite(float(loss1)) and float(loss2) < float(loss1) + 1.0
 
 
-def test_ring_flash_zigzag_rejected():
+def test_unknown_chunk_impl_rejected():
     q, k, v = _qkv()
     mesh = _mesh(("sp",), (2,))
-    with pytest.raises(ValueError, match="zigzag"):
-        ring_attention_sharded(q, k, v, mesh, layout="zigzag",
-                               chunk_impl="flash",
+    with pytest.raises(ValueError, match="chunk_impl"):
+        ring_attention_sharded(q, k, v, mesh, chunk_impl="pallas2",
                                batch_axis=None, head_axis=None)
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_zigzag_flash_matches_dense(sp):
+    q, k, v = _qkv()
+    mesh = _mesh(("sp",), (sp,))
+    got = ring_attention_sharded(q, k, v, mesh, layout="zigzag",
+                                 chunk_impl="flash",
+                                 batch_axis=None, head_axis=None)
+    want = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_zigzag_flash_grads_match_dense():
+    """The three-sub-block self step plus past/future slice accumulation
+    must reproduce dense gradients exactly (float32)."""
+    q, k, v = _qkv(b=1, s=32, h=2, d=8)
+    mesh = _mesh(("sp",), (4,))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.square(fn(q, k, v)))
+
+    want = jax.grad(loss(dense_attention), argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(loss(lambda q, k, v: ring_attention_sharded(
+        q, k, v, mesh, layout="zigzag", chunk_impl="flash",
+        batch_axis=None, head_axis=None)), argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
