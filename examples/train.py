@@ -32,7 +32,14 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=33)
     ap.add_argument("--attention", default="dense",
-                    choices=["dense", "flash", "blockwise", "ring"])
+                    choices=["dense", "flash", "blockwise", "ring",
+                             "ring_flash", "zigzag", "zigzag_flash",
+                             "ulysses"])
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialise each block in the backward "
+                         "(train longer sequences in the same HBM)")
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="microbatches per optimizer step")
     ap.add_argument("--checkpoint-dir", default="/tmp/mpi_tpu_train_ckpt")
     ap.add_argument("--checkpoint-every", type=int, default=10)
     ap.add_argument("--resume", action="store_true")
@@ -60,10 +67,13 @@ def main() -> None:
     mesh = make_mesh_nd(n)
     cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
                             d_ff=128, max_seq=64,
-                            attention_impl=args.attention)
-    print(f"mesh={dict(mesh.shape)} attention={args.attention}")
+                            attention_impl=args.attention,
+                            remat=args.remat)
+    print(f"mesh={dict(mesh.shape)} attention={args.attention} "
+          f"remat={args.remat} grad_accum={args.grad_accum}")
 
-    init_state, step = make_train_step(cfg, mesh=mesh, learning_rate=1e-2)
+    init_state, step = make_train_step(cfg, mesh=mesh, learning_rate=1e-2,
+                                       grad_accum=args.grad_accum)
     state = init_state(jax.random.PRNGKey(0))
     start = 0
     if args.resume:

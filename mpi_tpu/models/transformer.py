@@ -64,8 +64,9 @@ class TransformerConfig:
     # kernel per chunk with the FA-2 Pallas backward) | "zigzag" (ring
     # with the work-balanced zigzag causal layout) | "zigzag_flash"
     # (zigzag layout + flash chunks) | "ulysses" (all-to-all head/seq
-    # reshard, parallel.ulysses). The ring/zigzag/ulysses family needs
-    # a mesh with 'sp'.
+    # reshard, parallel.ulysses) | "ulysses_flash" (same, Pallas kernel
+    # per head group). The ring/zigzag/ulysses family needs a mesh
+    # with 'sp'.
     attention_impl: str = "dense"
     # Mixture-of-Experts FFN (0 = dense). Experts shard over the 'ep'
     # mesh axis (mpi_tpu.models.moe); aux load-balance loss is added to
@@ -75,6 +76,11 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
     moe_top_k: int = 1
+    # Rematerialise each block in the backward pass (jax.checkpoint):
+    # activations per block are recomputed instead of stored, trading
+    # ~1/3 more FLOPs for O(n_layers) less residual memory — the switch
+    # that lets long sequences train on one chip's HBM.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -195,13 +201,15 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
         chunk = "flash" if impl.endswith("_flash") else "fold"
         ctx = ring_attention_sharded(q, k, v, mesh, axis_name="sp",
                                      layout=layout, chunk_impl=chunk)
-    elif impl == "ulysses":
+    elif impl in ("ulysses", "ulysses_flash"):
         from ..parallel.ulysses import ulysses_attention_sharded
 
         if mesh is None:
             raise ValueError(
-                "attention_impl='ulysses' needs a mesh with an 'sp' axis")
-        ctx = ulysses_attention_sharded(q, k, v, mesh, axis_name="sp")
+                f"attention_impl={impl!r} needs a mesh with an 'sp' axis")
+        kernel = "flash" if impl.endswith("_flash") else "blockwise"
+        ctx = ulysses_attention_sharded(q, k, v, mesh, axis_name="sp",
+                                        kernel_impl=kernel)
     elif impl == "dense":
         from ..ops import dense_attention
 
@@ -209,7 +217,8 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     else:
         raise ValueError(
             f"unknown attention_impl {impl!r}: expected dense|flash|"
-            f"blockwise|ring|ring_flash|zigzag|zigzag_flash|ulysses")
+            f"blockwise|ring|ring_flash|zigzag|zigzag_flash|ulysses|"
+            f"ulysses_flash")
     return jnp.einsum("bshk,hkd->bsd", ctx, blk["wo"].astype(x.dtype))
 
 
@@ -266,7 +275,8 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
     x = x + params["pos"].astype(cfg.dtype)[:s][None]
     x = _act_constraint(x, mesh)
     aux = jnp.zeros((), jnp.float32)
-    for blk in params["blocks"]:
+
+    def block(x, blk):
         h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
                        blk["ln1"]["bias"].astype(x.dtype))
         x = x + _attention(h, blk, cfg, mesh)
@@ -274,9 +284,14 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
         h = _layernorm(x, blk["ln2"]["scale"].astype(x.dtype),
                        blk["ln2"]["bias"].astype(x.dtype))
         y, blk_aux = _ffn(h, blk, cfg, mesh)
-        aux = aux + blk_aux
         x = x + y
-        x = _act_constraint(x, mesh)
+        return _act_constraint(x, mesh), blk_aux
+
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    for blk in params["blocks"]:
+        x, blk_aux = block(x, blk)
+        aux = aux + blk_aux
     x = _layernorm(x, params["final_ln"]["scale"].astype(x.dtype),
                    params["final_ln"]["bias"].astype(x.dtype))
     return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype)), aux
@@ -304,13 +319,23 @@ def loss_fn(params, tokens, cfg: TransformerConfig,
 # --------------------------------------------------------------------------
 
 def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
-                     learning_rate: float = 1e-3):
+                     learning_rate: float = 1e-3, grad_accum: int = 1):
     """Build (init_state, step_body) with ``step_body`` left un-jitted —
     for callers that embed the step in a larger program (the bench
     harness scans it; :func:`make_train_step` jits it as-is). Both
-    callers therefore run the *same* optimizer step by construction."""
+    callers therefore run the *same* optimizer step by construction.
+
+    ``grad_accum=k`` splits the batch into ``k`` microbatches scanned
+    inside the step: gradients average across microbatches before ONE
+    optimizer update, so a batch k× larger than fits in HBM trains with
+    the full-batch math up to float reduction order (with MoE, the
+    load-balance aux loss is additionally computed per microbatch and
+    averaged). The batch must divide by ``k``."""
     import optax
 
+    if grad_accum < 1:
+        raise ValueError(f"mpi_tpu: grad_accum must be >= 1, got "
+                         f"{grad_accum}")
     opt = optax.adamw(learning_rate)
 
     def init_state(key: jax.Array):
@@ -329,9 +354,31 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
             opt_state = opt.init(params)
         return {"params": params, "opt": opt_state}
 
+    def accumulate(params, tokens):
+        """(mean loss, mean grads) over grad_accum microbatches."""
+        if grad_accum == 1:
+            return jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        b = tokens.shape[0]
+        if b % grad_accum:
+            raise ValueError(
+                f"mpi_tpu: batch {b} not divisible by grad_accum="
+                f"{grad_accum}")
+        micro = tokens.reshape(grad_accum, b // grad_accum,
+                               *tokens.shape[1:])
+
+        def body(carry, mtok):
+            loss_sum, gsum = carry
+            l, g = jax.value_and_grad(loss_fn)(params, mtok, cfg, mesh)
+            return (loss_sum + l, jax.tree.map(jnp.add, gsum, g)), None
+
+        zero = (jnp.zeros((), jnp.float32),
+                jax.tree.map(jnp.zeros_like, params))
+        (loss_sum, gsum), _ = lax.scan(body, zero, micro)
+        inv = 1.0 / grad_accum
+        return loss_sum * inv, jax.tree.map(lambda g: g * inv, gsum)
+
     def step(state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state["params"], tokens, cfg, mesh)
+        loss, grads = accumulate(state["params"], tokens)
         updates, new_opt = opt.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
         return {"params": new_params, "opt": new_opt}, loss
@@ -340,13 +387,15 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
 
 
 def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
-                    learning_rate: float = 1e-3):
+                    learning_rate: float = 1e-3, grad_accum: int = 1):
     """Build (init_state, step). ``step(state, tokens) -> (state, loss)``
     is one fully jitted optimizer step; with a mesh, params/opt-state are
     committed to :func:`param_specs` shardings and the batch to
-    ``P('dp', 'sp')`` so GSPMD inserts the dp grad-psum and tp reductions."""
+    ``P('dp', 'sp')`` so GSPMD inserts the dp grad-psum and tp
+    reductions. See :func:`make_train_parts` for ``grad_accum``."""
     init_state, step = make_train_parts(cfg, mesh=mesh,
-                                        learning_rate=learning_rate)
+                                        learning_rate=learning_rate,
+                                        grad_accum=grad_accum)
     return init_state, jax.jit(step)
 
 

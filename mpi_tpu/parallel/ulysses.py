@@ -24,16 +24,34 @@ import jax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import blockwise_attention
+from ..ops.attention import blockwise_attention, flash_attention
 
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = "sp", causal: bool = True,
-                      block_k: int = 128) -> jax.Array:
+                      block_k: int = 128,
+                      kernel_impl: str = "blockwise") -> jax.Array:
     """Per-device body (inside shard_map over ``axis_name``): shards are
-    ``(batch, seq_local, heads, head_dim)``; returns the same shape."""
+    ``(batch, seq_local, heads, head_dim)``; returns the same shape.
+
+    ``kernel_impl`` is the attention run on the resharded full-sequence
+    head group: ``"blockwise"`` (einsum scan, runs anywhere) or
+    ``"flash"`` (the Pallas kernel with its FA-2 Pallas backward —
+    differentiable through its custom vjp, so the all-to-alls and the
+    kernel autodiff together)."""
+    if kernel_impl == "flash":
+        def attend(q_, k_, v_):
+            return flash_attention(q_, k_, v_, causal)
+    elif kernel_impl == "blockwise":
+        def attend(q_, k_, v_):
+            return blockwise_attention(q_, k_, v_, causal=causal,
+                                       block_k=block_k)
+    else:
+        raise ValueError(
+            f"mpi_tpu: unknown ulysses kernel_impl {kernel_impl!r}: "
+            f"expected blockwise|flash")
     n = lax.axis_size(axis_name)
     h = q.shape[2]
     if h % n:
@@ -41,14 +59,13 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"mpi_tpu: ulysses needs heads ({h}) divisible by the sp axis "
             f"size ({n}); use ring attention otherwise")
     if n == 1:
-        return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+        return attend(q, k, v)
 
     def to_heads(x):  # (b, s/n, h, d) -> (b, s, h/n, d)
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
-    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    ctx = blockwise_attention(qh, kh, vh, causal=causal, block_k=block_k)
+    ctx = attend(to_heads(q), to_heads(k), to_heads(v))
     # (b, s, h/n, d) -> (b, s/n, h, d)
     return lax.all_to_all(ctx, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
@@ -58,7 +75,8 @@ def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                               mesh, axis_name: str = "sp",
                               causal: bool = True,
                               batch_axis: Optional[str] = "dp",
-                              head_axis: Optional[str] = None) -> jax.Array:
+                              head_axis: Optional[str] = None,
+                              kernel_impl: str = "blockwise") -> jax.Array:
     """shard_map wrapper over global ``(b, s, h, d)`` arrays. Heads may
     not additionally be tp-sharded here (the all-to-all owns the head
     axis), so ``head_axis`` defaults to None."""
@@ -71,7 +89,7 @@ def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
              head_axis if head_axis in names else None,
              None)
     body = functools.partial(ulysses_attention, axis_name=axis_name,
-                             causal=causal)
+                             causal=causal, kernel_impl=kernel_impl)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -148,3 +148,77 @@ def test_ulysses_attention_impl_in_sharded_model():
     got = jax.jit(lambda p, t: forward(p, t, cfg, mesh))(params, toks)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# Rematerialisation and gradient accumulation
+# --------------------------------------------------------------------------
+
+def _tiny(**kw):
+    return TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                             d_ff=64, max_seq=32, **kw)
+
+
+def _tokens(batch=4, seq=17, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, 64, (batch, seq)),
+        jnp.int32)
+
+
+def test_remat_matches_plain_step():
+    """remat=True recomputes activations in the backward but must leave
+    the math untouched: identical loss and identical updated params."""
+    results = []
+    for remat in (False, True):
+        init_state, step = make_train_step(_tiny(remat=remat))
+        state = init_state(jax.random.PRNGKey(0))
+        state, loss = step(state, _tokens())
+        results.append((float(loss), state["params"]))
+    (l0, p0), (l1, p1) = results
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accum_matches_full_batch():
+    """grad_accum=k over the batch must produce the same mean loss and
+    the same optimizer update as one full-batch step (equal microbatch
+    sizes make mean-of-means exact)."""
+    cfg = _tiny()
+    tok = _tokens(batch=4)
+    ref_init, ref_step = make_train_step(cfg)
+    state = ref_init(jax.random.PRNGKey(0))
+    ref_state, ref_loss = ref_step(state, tok)
+
+    acc_init, acc_step = make_train_step(cfg, grad_accum=2)
+    state2 = acc_init(jax.random.PRNGKey(0))
+    acc_state, acc_loss = acc_step(state2, tok)
+
+    np.testing.assert_allclose(float(ref_loss), float(acc_loss), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(ref_state["params"]),
+                    jax.tree.leaves(acc_state["params"])):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_grad_accum_rejects_indivisible_batch():
+    init_state, step = make_train_step(_tiny(), grad_accum=3)
+    state = init_state(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="divisible"):
+        step(state, _tokens(batch=4))
+
+
+def test_remat_grad_accum_sharded_step():
+    """Both features compose with a dp x tp mesh (long-context training
+    shape: remat for memory, accumulation for global batch)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.asarray(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("dp", "tp"))
+    cfg = _tiny(remat=True)
+    init_state, step = make_train_step(cfg, mesh=mesh, grad_accum=2)
+    state = init_state(jax.random.PRNGKey(0))
+    tok = jax.device_put(_tokens(batch=4),
+                         NamedSharding(mesh, P("dp", None)))
+    state, loss1 = step(state, tok)
+    state, loss2 = step(state, tok)
+    assert np.isfinite(float(loss1)) and float(loss2) < float(loss1)
