@@ -34,12 +34,17 @@ def main() -> None:
     ap.add_argument("--attention", default="dense",
                     choices=["dense", "flash", "blockwise", "ring",
                              "ring_flash", "zigzag", "zigzag_flash",
-                             "ulysses"])
+                             "ulysses", "ulysses_flash"])
     ap.add_argument("--remat", action="store_true",
                     help="rematerialise each block in the backward "
                          "(train longer sequences in the same HBM)")
     ap.add_argument("--grad-accum", type=int, default=1,
                     help="microbatches per optimizer step")
+    ap.add_argument("--optimizer", default="adamw",
+                    choices=["adamw", "adafactor", "sgd"])
+    ap.add_argument("--warmup-steps", type=int, default=0,
+                    help="linear LR warmup; with --steps it becomes "
+                         "warmup + cosine decay")
     ap.add_argument("--checkpoint-dir", default="/tmp/mpi_tpu_train_ckpt")
     ap.add_argument("--checkpoint-every", type=int, default=10)
     ap.add_argument("--resume", action="store_true")
@@ -72,16 +77,23 @@ def main() -> None:
     print(f"mesh={dict(mesh.shape)} attention={args.attention} "
           f"remat={args.remat} grad_accum={args.grad_accum}")
 
-    init_state, step = make_train_step(cfg, mesh=mesh, learning_rate=1e-2,
-                                       grad_accum=args.grad_accum)
-    state = init_state(jax.random.PRNGKey(0))
+    # Resolve the resume point BEFORE building the step: the LR schedule
+    # horizon is the absolute final step (start + steps), so a resumed
+    # run continues the same warmup/cosine curve instead of restarting
+    # its decay from the restored optimizer count.
     start = 0
     if args.resume:
         last = latest_step(args.checkpoint_dir)
         if last is not None:
             start = last
-            state = restore_checkpoint(args.checkpoint_dir, state)
-            print(f"resumed from step {start}")
+    init_state, step = make_train_step(
+        cfg, mesh=mesh, learning_rate=1e-2, grad_accum=args.grad_accum,
+        optimizer=args.optimizer, warmup_steps=args.warmup_steps,
+        total_steps=start + args.steps if args.warmup_steps else None)
+    state = init_state(jax.random.PRNGKey(0))
+    if start:
+        state = restore_checkpoint(args.checkpoint_dir, state)
+        print(f"resumed from step {start}")
 
     # Deterministic, resumable, dp-sharded stream with host-side prefetch
     # (restart at --resume replays exactly the batches it would have seen).

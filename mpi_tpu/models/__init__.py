@@ -16,6 +16,7 @@ from .transformer import (
     forward_with_aux,
     param_specs,
     sanitize_spec,
+    make_optimizer,
     make_train_parts,
     make_train_step,
     make_mesh_nd,
@@ -30,6 +31,7 @@ __all__ = [
     "forward_with_aux",
     "param_specs",
     "sanitize_spec",
+    "make_optimizer",
     "make_train_parts",
     "make_train_step",
     "make_mesh_nd",

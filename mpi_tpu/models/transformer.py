@@ -42,6 +42,7 @@ __all__ = [
     "forward_with_aux",
     "param_specs",
     "sanitize_spec",
+    "make_optimizer",
     "make_train_parts",
     "make_train_step",
     "make_mesh_nd",
@@ -318,8 +319,44 @@ def loss_fn(params, tokens, cfg: TransformerConfig,
 # Training step
 # --------------------------------------------------------------------------
 
+def make_optimizer(optimizer: str = "adamw", learning_rate: float = 1e-3,
+                   warmup_steps: int = 0, total_steps: Optional[int] = None):
+    """An optax optimizer by name with an optional schedule.
+
+    ``optimizer``: ``"adamw"`` (default), ``"adafactor"`` (factored
+    second moment — the TPU-classic choice when optimizer state must not
+    double the parameter memory), or ``"sgd"`` (momentum 0.9).
+
+    Schedule: with ``total_steps``, linear warmup over ``warmup_steps``
+    into cosine decay to 10% of peak at ``total_steps``; with only
+    ``warmup_steps``, linear warmup then constant; otherwise constant
+    ``learning_rate``."""
+    import optax
+
+    if total_steps is not None:
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=learning_rate,
+            warmup_steps=max(warmup_steps, 1), decay_steps=total_steps,
+            end_value=0.1 * learning_rate)
+    elif warmup_steps:
+        lr = optax.linear_schedule(0.0, learning_rate, warmup_steps)
+    else:
+        lr = learning_rate
+    if optimizer == "adamw":
+        return optax.adamw(lr)
+    if optimizer == "adafactor":
+        return optax.adafactor(learning_rate=lr)
+    if optimizer == "sgd":
+        return optax.sgd(lr, momentum=0.9)
+    raise ValueError(
+        f"mpi_tpu: unknown optimizer {optimizer!r}: expected "
+        f"adamw|adafactor|sgd")
+
+
 def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
-                     learning_rate: float = 1e-3, grad_accum: int = 1):
+                     learning_rate: float = 1e-3, grad_accum: int = 1,
+                     optimizer: str = "adamw", warmup_steps: int = 0,
+                     total_steps: Optional[int] = None):
     """Build (init_state, step_body) with ``step_body`` left un-jitted —
     for callers that embed the step in a larger program (the bench
     harness scans it; :func:`make_train_step` jits it as-is). Both
@@ -330,13 +367,17 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     optimizer update, so a batch k× larger than fits in HBM trains with
     the full-batch math up to float reduction order (with MoE, the
     load-balance aux loss is additionally computed per microbatch and
-    averaged). The batch must divide by ``k``."""
+    averaged). The batch must divide by ``k``.
+
+    ``optimizer``/``warmup_steps``/``total_steps`` select the update
+    rule and schedule — see :func:`make_optimizer`."""
     import optax
 
     if grad_accum < 1:
         raise ValueError(f"mpi_tpu: grad_accum must be >= 1, got "
                          f"{grad_accum}")
-    opt = optax.adamw(learning_rate)
+    opt = make_optimizer(optimizer, learning_rate, warmup_steps,
+                         total_steps)
 
     def init_state(key: jax.Array):
         params = init_params(key, cfg)
@@ -387,15 +428,21 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
 
 
 def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
-                    learning_rate: float = 1e-3, grad_accum: int = 1):
+                    learning_rate: float = 1e-3, grad_accum: int = 1,
+                    optimizer: str = "adamw", warmup_steps: int = 0,
+                    total_steps: Optional[int] = None):
     """Build (init_state, step). ``step(state, tokens) -> (state, loss)``
     is one fully jitted optimizer step; with a mesh, params/opt-state are
     committed to :func:`param_specs` shardings and the batch to
     ``P('dp', 'sp')`` so GSPMD inserts the dp grad-psum and tp
-    reductions. See :func:`make_train_parts` for ``grad_accum``."""
+    reductions. See :func:`make_train_parts` for ``grad_accum`` and the
+    optimizer/schedule options."""
     init_state, step = make_train_parts(cfg, mesh=mesh,
                                         learning_rate=learning_rate,
-                                        grad_accum=grad_accum)
+                                        grad_accum=grad_accum,
+                                        optimizer=optimizer,
+                                        warmup_steps=warmup_steps,
+                                        total_steps=total_steps)
     return init_state, jax.jit(step)
 
 
