@@ -11,6 +11,9 @@ from .attention import (
     blockwise_attention,
     dense_attention,
     flash_attention,
+    flash_attention_with_lse,
+    flash_chunk_bwd,
+    merge_attention_chunks,
 )
 from .ring_collectives import (
     ring_allgather,
@@ -23,6 +26,9 @@ __all__ = [
     "dense_attention",
     "blockwise_attention",
     "flash_attention",
+    "flash_attention_with_lse",
+    "flash_chunk_bwd",
+    "merge_attention_chunks",
     "ring_allgather",
     "ring_allgather_sharded",
     "ring_allreduce",
