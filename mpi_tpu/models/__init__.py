@@ -16,6 +16,7 @@ from .transformer import (
     forward_with_aux,
     param_specs,
     sanitize_spec,
+    apply_rope,
     make_optimizer,
     make_train_parts,
     make_train_step,
@@ -31,6 +32,7 @@ __all__ = [
     "forward_with_aux",
     "param_specs",
     "sanitize_spec",
+    "apply_rope",
     "make_optimizer",
     "make_train_parts",
     "make_train_step",

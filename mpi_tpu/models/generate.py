@@ -22,31 +22,50 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .transformer import TransformerConfig, _ffn, _layernorm
+from .transformer import TransformerConfig, _ffn, _layernorm, apply_rope
 
 __all__ = ["prefill", "decode_step", "generate"]
 
 
-def _proj_qkv(x, blk, dtype):
+def _proj_qkv(x, blk, cfg, n_valid):
+    """q/k/v projections for tokens starting at absolute position
+    ``n_valid``; under rope, q and k are rotated by their positions HERE
+    — k enters the cache already rotated, so cached entries never need
+    re-rotation as decode advances."""
+    dtype = x.dtype
     q = jnp.einsum("bsd,dhk->bshk", x, blk["wq"].astype(dtype))
     k = jnp.einsum("bsd,dhk->bshk", x, blk["wk"].astype(dtype))
     v = jnp.einsum("bsd,dhk->bshk", x, blk["wv"].astype(dtype))
+    if cfg.rope:
+        pos = n_valid + jnp.arange(x.shape[1], dtype=jnp.int32)
+        q = apply_rope(q, pos, cfg.rope_theta)
+        k = apply_rope(k, pos, cfg.rope_theta)
     return q, k, v
 
 
 def _attend_cached(q, k_cache, v_cache, n_valid, cfg):
     """q: (b, s_q, h, hd) attends to cache positions [0, n_valid + s_q)
-    with causal offsets; cache: (b, max_seq, h, hd)."""
+    with causal offsets; cache: (b, max_seq, kv_heads, hd).
+
+    GQA stays *grouped* through the contraction — queries reshape to
+    (b, s, kv, group, hd) and each kv head is read once per step rather
+    than materialised group x larger, so decode keeps GQA's bandwidth
+    and peak-memory win (the point of the smaller cache)."""
+    b, s_q, h, hd = q.shape
+    kv = cfg.kv_heads
+    group = h // kv
+    qg = q.reshape(b, s_q, kv, group, hd)
     scale = 1.0 / math.sqrt(cfg.head_dim)
-    logits = jnp.einsum("bshk,bthk->bhst", q, k_cache) * scale
-    s_q, t = q.shape[1], k_cache.shape[1]
+    logits = jnp.einsum("bsKgk,btKk->bKgst", qg, k_cache) * scale
+    t = k_cache.shape[1]
     # query i sits at absolute position n_valid + i; it may see cache
     # columns 0 .. n_valid + i.
     rows = n_valid + lax.broadcasted_iota(jnp.int32, (s_q, t), 0)
     cols = lax.broadcasted_iota(jnp.int32, (s_q, t), 1)
-    logits = jnp.where((cols <= rows)[None, None], logits, -1e30)
+    logits = jnp.where((cols <= rows)[None, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    return jnp.einsum("bhst,bthk->bshk", probs.astype(q.dtype), v_cache)
+    ctx = jnp.einsum("bKgst,btKk->bsKgk", probs.astype(q.dtype), v_cache)
+    return ctx.reshape(b, s_q, h, hd)
 
 
 def _forward_cached(params, tokens, cache, n_valid, cfg: TransformerConfig):
@@ -54,14 +73,15 @@ def _forward_cached(params, tokens, cache, n_valid, cfg: TransformerConfig):
     writing their k/v into the cache. Returns (logits, new_cache)."""
     b, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
-    pos_emb = lax.dynamic_slice_in_dim(
-        params["pos"].astype(cfg.dtype), n_valid, s, axis=0)
-    x = x + pos_emb[None]
+    if not cfg.rope:
+        pos_emb = lax.dynamic_slice_in_dim(
+            params["pos"].astype(cfg.dtype), n_valid, s, axis=0)
+        x = x + pos_emb[None]
     new_cache = []
     for i, blk in enumerate(params["blocks"]):
         h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
                        blk["ln1"]["bias"].astype(x.dtype))
-        q, k, v = _proj_qkv(h, blk, x.dtype)
+        q, k, v = _proj_qkv(h, blk, cfg, n_valid)
         k_cache = lax.dynamic_update_slice_in_dim(
             cache[i][0], k, n_valid, axis=1)
         v_cache = lax.dynamic_update_slice_in_dim(
@@ -80,7 +100,8 @@ def _forward_cached(params, tokens, cache, n_valid, cfg: TransformerConfig):
 
 
 def _empty_cache(cfg: TransformerConfig, batch: int):
-    shape = (batch, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+    # kv_heads, not n_heads: GQA shrinks the cache by the group factor.
+    shape = (batch, cfg.max_seq, cfg.kv_heads, cfg.head_dim)
     return [(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
             for _ in range(cfg.n_layers)]
 

@@ -42,6 +42,7 @@ __all__ = [
     "forward_with_aux",
     "param_specs",
     "sanitize_spec",
+    "apply_rope",
     "make_optimizer",
     "make_train_parts",
     "make_train_step",
@@ -82,11 +83,33 @@ class TransformerConfig:
     # ~1/3 more FLOPs for O(n_layers) less residual memory — the switch
     # that lets long sequences train on one chip's HBM.
     remat: bool = False
+    # Grouped-query attention: number of k/v heads (None = n_heads,
+    # plain MHA; 1 = MQA). Queries keep n_heads; k/v project to
+    # n_kv_heads and are repeated across each group before the kernel,
+    # shrinking k/v projection weights and the KV cache by
+    # n_heads/n_kv_heads. Must divide n_heads (and the tp axis size
+    # when tensor-parallel).
+    n_kv_heads: Optional[int] = None
+    # Rotary position embeddings instead of the learned absolute table:
+    # q/k are phase-rotated by their global positions before attention
+    # (and before any sequence sharding, so ring/zigzag layouts carry
+    # the already-encoded values). head_dim must be even.
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_heads if self.n_kv_heads is None else self.n_kv_heads
+        if not 1 <= kv <= self.n_heads or self.n_heads % kv:
+            raise ValueError(
+                f"mpi_tpu: n_kv_heads={kv} must divide n_heads="
+                f"{self.n_heads}")
+        return kv
 
 
 # --------------------------------------------------------------------------
@@ -105,22 +128,23 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
     params: Dict[str, Any] = {
         "embed": _dense_init(keys[0], (cfg.vocab, cfg.d_model), pd,
                              cfg.d_model),
-        "pos": _dense_init(keys[1], (cfg.max_seq, cfg.d_model), pd,
-                           cfg.d_model),
         "final_ln": {"scale": jnp.ones((cfg.d_model,), pd),
                      "bias": jnp.zeros((cfg.d_model,), pd)},
         "blocks": [],
     }
+    if not cfg.rope:  # rope needs no learned position table
+        params["pos"] = _dense_init(keys[1], (cfg.max_seq, cfg.d_model),
+                                    pd, cfg.d_model)
     for i in range(cfg.n_layers):
         ks = jax.random.split(keys[2 + i], 6)
         h, d, f = cfg.n_heads, cfg.d_model, cfg.d_ff
-        hd = cfg.head_dim
+        hd, kv = cfg.head_dim, cfg.kv_heads
         blk = {
             "ln1": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
             "ln2": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
             "wq": _dense_init(ks[0], (d, h, hd), pd, d),
-            "wk": _dense_init(ks[1], (d, h, hd), pd, d),
-            "wv": _dense_init(ks[2], (d, h, hd), pd, d),
+            "wk": _dense_init(ks[1], (d, kv, hd), pd, d),
+            "wv": _dense_init(ks[2], (d, kv, hd), pd, d),
             "wo": _dense_init(ks[3], (h, hd, d), pd, d),
         }
         if cfg.n_experts > 0:
@@ -156,12 +180,14 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     else:
         blk["w1"] = P(None, "tp")
         blk["w2"] = P("tp", None)
-    return {
+    specs = {
         "embed": P("tp", None),
-        "pos": P(),
         "final_ln": {"scale": P(), "bias": P()},
         "blocks": [dict(blk) for _ in range(cfg.n_layers)],
     }
+    if not cfg.rope:
+        specs["pos"] = P()
+    return specs
 
 
 # --------------------------------------------------------------------------
@@ -174,6 +200,41 @@ def _layernorm(x, scale, bias, eps=1e-5):
     return (x - mu) * lax.rsqrt(var + eps) * scale + bias
 
 
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> jax.Array:
+    """Rotary position embedding: rotate each half-dim pair of ``x``
+    ``(b, s, h, hd)`` by its position's phase. ``positions`` is ``(s,)``
+    int32 global positions (works for shifted windows — decode passes
+    ``n_valid + arange``). Phases are computed in float32 and the result
+    cast back to x's dtype."""
+    hd = x.shape[-1]
+    if hd % 2:
+        raise ValueError(f"mpi_tpu: rope needs an even head_dim, got {hd}")
+    half = hd // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs  # (s, half)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def repeat_kv_heads(k, v, cfg: TransformerConfig):
+    """Expand GQA k/v ``(b, s, kv_heads, hd)`` to full ``n_heads`` for
+    kernels that expect equal q/k head counts. This MATERIALISES the
+    group-times-larger k/v, so training with GQA saves projection
+    weights and the decode KV cache (which stays grouped —
+    generate._attend_cached) but not attention activation memory;
+    grouped-q kernel support is the remaining optimisation."""
+    group = cfg.n_heads // cfg.kv_heads
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    return k, v
+
+
 def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     """Causal multi-head attention; heads are the tp-sharded axis, so every
     einsum below is head-batched and GSPMD keeps it local to each tp shard
@@ -183,6 +244,13 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     q = jnp.einsum("bsd,dhk->bshk", x, blk["wq"].astype(x.dtype))
     k = jnp.einsum("bsd,dhk->bshk", x, blk["wk"].astype(x.dtype))
     v = jnp.einsum("bsd,dhk->bshk", x, blk["wv"].astype(x.dtype))
+    if cfg.rope:
+        # Global positions, applied BEFORE any sequence sharding — the
+        # ring/zigzag layouts then carry already-rotated values.
+        pos = jnp.arange(s, dtype=jnp.int32)
+        q = apply_rope(q, pos, cfg.rope_theta)
+        k = apply_rope(k, pos, cfg.rope_theta)
+    k, v = repeat_kv_heads(k, v, cfg)
     impl = cfg.attention_impl
     if impl == "flash":
         from ..ops import flash_attention
@@ -273,7 +341,8 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
     ``aux_loss`` is the summed MoE load-balance penalty (0 for dense)."""
     _, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
-    x = x + params["pos"].astype(cfg.dtype)[:s][None]
+    if not cfg.rope:
+        x = x + params["pos"].astype(cfg.dtype)[:s][None]
     x = _act_constraint(x, mesh)
     aux = jnp.zeros((), jnp.float32)
 
@@ -376,6 +445,13 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     if grad_accum < 1:
         raise ValueError(f"mpi_tpu: grad_accum must be >= 1, got "
                          f"{grad_accum}")
+    if mesh is not None and "tp" in mesh.axis_names:
+        tp = mesh.shape["tp"]
+        if cfg.n_heads % tp or cfg.kv_heads % tp:
+            raise ValueError(
+                f"mpi_tpu: tp={tp} must divide n_heads={cfg.n_heads} and "
+                f"kv_heads={cfg.kv_heads} (GQA shards kv heads over tp "
+                f"too)")
     opt = make_optimizer(optimizer, learning_rate, warmup_steps,
                          total_steps)
 
