@@ -85,10 +85,11 @@ class TransformerConfig:
     remat: bool = False
     # Grouped-query attention: number of k/v heads (None = n_heads,
     # plain MHA; 1 = MQA). Queries keep n_heads; k/v project to
-    # n_kv_heads and are repeated across each group before the kernel,
-    # shrinking k/v projection weights and the KV cache by
-    # n_heads/n_kv_heads. Must divide n_heads (and the tp axis size
-    # when tensor-parallel).
+    # n_kv_heads, shrinking k/v projection weights and the KV cache by
+    # n_heads/n_kv_heads. The flash kernel and the decode path read
+    # grouped heads natively; other impls repeat k/v before the kernel
+    # (repeat_kv_heads). Must divide n_heads (and the tp axis size when
+    # tensor-parallel).
     n_kv_heads: Optional[int] = None
     # Rotary position embeddings instead of the learned absolute table:
     # q/k are phase-rotated by their global positions before attention
@@ -223,11 +224,12 @@ def apply_rope(x: jax.Array, positions: jax.Array,
 
 def repeat_kv_heads(k, v, cfg: TransformerConfig):
     """Expand GQA k/v ``(b, s, kv_heads, hd)`` to full ``n_heads`` for
-    kernels that expect equal q/k head counts. This MATERIALISES the
-    group-times-larger k/v, so training with GQA saves projection
-    weights and the decode KV cache (which stays grouped —
-    generate._attend_cached) but not attention activation memory;
-    grouped-q kernel support is the remaining optimisation."""
+    kernels that expect equal q/k head counts — every impl EXCEPT
+    ``flash``, whose Pallas kernels read grouped heads natively through
+    their index maps, and the decode path (generate._attend_cached),
+    whose contraction stays grouped. Here the repeat MATERIALISES the
+    group-times-larger k/v, so for these impls GQA saves projection
+    weights but not attention activation memory."""
     group = cfg.n_heads // cfg.kv_heads
     if group > 1:
         k = jnp.repeat(k, group, axis=2)
@@ -250,8 +252,11 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
         pos = jnp.arange(s, dtype=jnp.int32)
         q = apply_rope(q, pos, cfg.rope_theta)
         k = apply_rope(k, pos, cfg.rope_theta)
-    k, v = repeat_kv_heads(k, v, cfg)
     impl = cfg.attention_impl
+    if impl != "flash":
+        # The flash kernel reads grouped kv heads natively through its
+        # index maps; every other impl expects equal head counts.
+        k, v = repeat_kv_heads(k, v, cfg)
     if impl == "flash":
         from ..ops import flash_attention
 

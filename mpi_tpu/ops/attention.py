@@ -262,14 +262,18 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
                           scale: float, block_q: int, block_k: int,
-                          seq_k: int):
-    """dv = Σ_q Pᵀ·dO and dk = Σ_q dsᵀ·Q. Grid (bh, nk, nq): each
-    (bh, ki) accumulates over the query blocks."""
+                          seq_k: int, nq: int):
+    """dv = Σ_q Pᵀ·dO and dk = Σ_q dsᵀ·Q. Grid (b·kv_heads, nk, G·nq):
+    each (bh, ki) accumulates over the query blocks of EVERY query head
+    in the kv head's group (G = n_heads / kv_heads; 1 for MHA) — the
+    third grid axis enumerates (g, qi) pairs g-major, and the index
+    maps point q/g/lse/delta at query head g of the group."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    t = pl.program_id(2)
+    qi = t % nq  # query-block index within the current group member
+    nt = pl.num_programs(2)
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -300,7 +304,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     else:
         compute()
 
-    @pl.when(qi == nq - 1)
+    @pl.when(t == nt - 1)
     def _():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -345,11 +349,53 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     itp = _should_interpret() if interpret is None else interpret
     if not _HAVE_PALLAS:  # pragma: no cover
+        k, v = _expand_grouped_kv(q, k, v)
         return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
     # Same kernel as the residual-saving forward; the (b*h, 1, s) lse
     # output is dead here and DCE'd by XLA.
     return _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k,
                                  itp)[0]
+
+
+def _expand_grouped_kv(q, k, v):
+    """Repeat grouped (GQA) kv heads for paths without native grouped
+    support (the no-Pallas blockwise fallback only). Enforces the same
+    divisibility contract as :func:`_gqa_layout` so all builds raise
+    the same error."""
+    h, hk = q.shape[2], k.shape[2]
+    if h % hk or v.shape[2] != hk:
+        raise ValueError(
+            f"mpi_tpu: flash attention kv heads ({hk}/{v.shape[2]}) must "
+            f"divide query heads ({h})")
+    group = h // hk
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    return k, v
+
+
+def _gqa_layout(q, k, v):
+    """Flattened-head layout shared by the kernels: queries as
+    ``(b*h, s, d)``, k/v as ``(b*kv_heads, t, d)``, plus the index-map
+    taking a flat query-head grid index to its kv head's flat index
+    (query head i reads kv head ``i // group`` — the GQA convention;
+    the map is the identity for MHA)."""
+    b, s, h, d = q.shape
+    t = k.shape[1]
+    hk = k.shape[2]
+    if h % hk or v.shape[2] != hk:
+        raise ValueError(
+            f"mpi_tpu: flash attention kv heads ({hk}/{v.shape[2]}) must "
+            f"divide query heads ({h})")
+    group = h // hk
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * hk, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * hk, t, d)
+
+    def kv_index(bh):
+        return (bh // h) * hk + (bh % h) // group
+
+    return qf, kf, vf, kv_index, group
 
 
 def _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k, interpret):
@@ -358,14 +404,14 @@ def _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k, interpret):
     ``out`` is ``(b, s, h, d)``; ``lse`` stays in the kernels'
     ``(b*h, 1, s)`` row layout (the singleton middle dim satisfies
     Mosaic's trailing-two-dims tiling rule) — exactly what the backward
-    row specs consume."""
+    row specs consume. k/v may carry fewer (grouped/GQA) heads; the
+    kernel reads each kv head once per query head via the index map —
+    nothing is materialised group-times larger."""
     b, s, h, d = q.shape
     t = k.shape[1]
     bq = _pick_block(s, block_q)
     bk = _pick_block(t, block_k)
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    qf, kf, vf, kv_index, _ = _gqa_layout(q, k, v)
     grid = (b * h, s // bq, t // bk)
     kernel = functools.partial(
         _flash_kernel_fwd_res, causal=causal, scale=_scale(q), block_q=bq,
@@ -375,8 +421,10 @@ def _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda bh, qi, ki: (kv_index(bh), ki, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda bh, qi, ki: (kv_index(bh), ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -403,14 +451,17 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
                       interpret):
     """FlashAttention-2 backward: two Pallas passes (dq over key blocks;
     dk/dv over query blocks), probabilities rebuilt from lse — no O(s²)
-    residuals, float32 accumulation throughout."""
+    residuals, float32 accumulation throughout. Grouped (GQA) k/v are
+    handled natively: dq reads each kv head through the group index
+    map, and the dk/dv grid enumerates every (group member, query
+    block) pair so the per-kv-head scratch accumulates the whole
+    group's contributions before one write."""
     b, s, h, d = q.shape
     t = k.shape[1]
+    hk = k.shape[2]
     bq = _pick_block(s, block_q)
     bk = _pick_block(t, block_k)
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    qf, kf, vf, kv_index, group = _gqa_layout(q, k, v)
     gf = g.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     of = out.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     # δ_i = Σ_d dO_i·O_i — cheap elementwise reduction; XLA fuses it.
@@ -421,7 +472,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
     common = dict(causal=causal, scale=_scale(q), block_q=bq, block_k=bk,
                   seq_k=t)
     qspec = pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0))
-    kspec = pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0))
+    kspec = pl.BlockSpec((1, bk, d),
+                         lambda bh, qi, ki: (kv_index(bh), ki, 0))
     rowspec = pl.BlockSpec((1, 1, bq), lambda bh, qi, ki: (bh, 0, qi))
 
     dq = pl.pallas_call(
@@ -434,19 +486,28 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
-    # dk/dv grid transposes the roles: ki is the accumulation owner, qi
-    # the reduction dimension — index maps swap accordingly.
-    qspec2 = pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0))
-    kspec2 = pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0))
-    rowspec2 = pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, qi))
+    # dk/dv: grid (b*hk, nk, group*nq) — ki owns the accumulation, the
+    # third axis walks the group's query heads g-major so the scratch
+    # gathers all of them; index maps send q/g/lse/delta at group
+    # member g's flat query head.
+    nq = s // bq
+
+    def q_head(bh, gq):
+        return (bh // hk) * h + (bh % hk) * group + gq // nq
+
+    qspec2 = pl.BlockSpec(
+        (1, bq, d), lambda bh, ki, gq: (q_head(bh, gq), gq % nq, 0))
+    kspec2 = pl.BlockSpec((1, bk, d), lambda bh, ki, gq: (bh, ki, 0))
+    rowspec2 = pl.BlockSpec(
+        (1, 1, bq), lambda bh, ki, gq: (q_head(bh, gq), 0, gq % nq))
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, **common),
-        grid=(b * h, t // bk, s // bq),
+        functools.partial(_flash_bwd_dkv_kernel, nq=nq, **common),
+        grid=(b * hk, t // bk, group * nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=[kspec2, kspec2],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+            jax.ShapeDtypeStruct((b * hk, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * hk, t, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -455,8 +516,9 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
-    unflat = lambda x, n: x.reshape(b, h, n, d).transpose(0, 2, 1, 3)  # noqa: E731
-    return unflat(dq, s), unflat(dk, t), unflat(dv, t)
+    unflat_q = lambda x: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)  # noqa: E731
+    unflat_kv = lambda x: x.reshape(b, hk, t, d).transpose(0, 2, 1, 3)  # noqa: E731
+    return unflat_q(dq), unflat_kv(dk), unflat_kv(dv)
 
 
 def flash_attention_with_lse(q, k, v, causal: bool = True,
@@ -508,7 +570,8 @@ def flash_chunk_bwd(q, k, v, out, lse, g, causal: bool = False,
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
     itp = _should_interpret() if interpret is None else interpret
     if not _HAVE_PALLAS:  # pragma: no cover
-        out = blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+        ke, ve = _expand_grouped_kv(q, k, v)
+        out = blockwise_attention(q, ke, ve, causal=causal, block_k=block_k)
         return out, (q, k, v, None, None)
     out, lse = _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k, itp)
     return out, (q, k, v, out, lse)
@@ -517,9 +580,12 @@ def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
 def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
     if out is None:  # pragma: no cover - pallas-less fallback
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: blockwise_attention(
-                q_, k_, v_, causal=causal, block_k=block_k), q, k, v)
+        def ref(q_, k_, v_):
+            ke, ve = _expand_grouped_kv(q_, k_, v_)
+            return blockwise_attention(q_, ke, ve, causal=causal,
+                                       block_k=block_k)
+
+        _, vjp = jax.vjp(ref, q, k, v)
         return vjp(g)
     itp = _should_interpret() if interpret is None else interpret
     return _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q,

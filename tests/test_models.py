@@ -369,3 +369,16 @@ def test_gqa_tp_indivisible_rejected():
     mesh = Mesh(devs, ("dp", "tp"))
     with pytest.raises(ValueError, match="tp"):
         make_train_step(_tiny(n_kv_heads=2), mesh=mesh)
+
+
+def test_gqa_flash_impl_matches_dense_forward():
+    """attention_impl='flash' with GQA uses the kernels' native grouped
+    path (no repeat) and must match the dense impl's output."""
+    cfg_d = _tiny(n_kv_heads=2)
+    cfg_f = _tiny(n_kv_heads=2, attention_impl="flash")
+    p = init_params(jax.random.PRNGKey(0), cfg_d)
+    toks = _tokens()[:, :-1]
+    want = forward(p, toks, cfg_d)
+    got = forward(p, toks, cfg_f)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
