@@ -13,7 +13,8 @@ point-to-point library over TCP, /root/reference) designed TPU-first:
     ``jax.sharding.Mesh`` axis and lowers communication to XLA collectives
     over ICI/DCN;
   * **new** collectives — ``reduce``/``bcast``/``allgather``/``allreduce``/
-    ``gather``/``scatter``/``alltoall``/``barrier`` (the reference stubs
+    ``gather``/``scatter``/``alltoall``/``scan``/``exscan``/``barrier``
+    (the reference stubs
     ``AllReduce`` out, mpi.go:130);
   * a functional layer (:mod:`mpi_tpu.parallel`) for use *inside* ``jit``
     ted SPMD code, plus Pallas ring/DMA kernels (:mod:`mpi_tpu.ops`).
@@ -40,6 +41,8 @@ from .api import (
     reduce_scatter,
     register,
     registered,
+    scan,
+    exscan,
     scatter,
     send,
     sendrecv,
@@ -70,6 +73,8 @@ __all__ = [
     "reduce_scatter",
     "register",
     "registered",
+    "scan",
+    "exscan",
     "scatter",
     "send",
     "sendrecv",

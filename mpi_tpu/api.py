@@ -24,7 +24,8 @@ goroutines.
 
 **New capability beyond the reference** (the north star): collectives.
 ``reduce``/``bcast``/``allgather``/``allreduce``/``barrier``/``scatter``/
-``gather``/``alltoall`` — the reference stubs ``AllReduce`` out entirely
+``gather``/``alltoall``/``scan``/``exscan`` — the reference stubs
+``AllReduce`` out entirely
 (mpi.go:130, 69-71). Backends may implement them natively (the XLA driver
 lowers them to ``jax.lax`` collectives over ICI); otherwise the facade falls
 back to generic tree/ring algorithms built on ``send``/``receive``
@@ -57,6 +58,8 @@ __all__ = [
     "gather",
     "scatter",
     "alltoall",
+    "scan",
+    "exscan",
     "barrier",
     "Raw",
     "MpiError",
@@ -393,6 +396,18 @@ def alltoall(data: List[Any]) -> List[Any]:
     """Personalized all-to-all: element j of this rank's list goes to rank
     j; returns the list of payloads received, ordered by source rank."""
     return _collective("alltoall", data)
+
+
+def scan(data: Any, op: str = "sum") -> Any:
+    """Inclusive prefix reduction in rank order: rank r gets the
+    combination of ranks 0..r (MPI_Scan)."""
+    return _collective("scan", data, op=op)
+
+
+def exscan(data: Any, op: str = "sum") -> Optional[Any]:
+    """Exclusive prefix reduction: rank r gets ranks 0..r-1 combined;
+    rank 0 gets None (MPI_Exscan)."""
+    return _collective("exscan", data, op=op)
 
 
 def barrier() -> None:

@@ -45,6 +45,8 @@ __all__ = [
     "scatter",
     "allgather",
     "alltoall",
+    "scan",
+    "exscan",
     "barrier",
 ]
 
@@ -305,6 +307,46 @@ def alltoall(impl: Interface, data: List[Any]) -> List[Any]:
         src = (me - offset) % n
         out[src] = _sendrecv(impl, data[dst], dst, src, tag + offset)
     return out
+
+
+def _allgather_best(impl: Interface, data: Any) -> List[Any]:
+    """The backend's native allgather when it has one (the xla driver's
+    is a single compiled XLA program), else the generic ring."""
+    native = getattr(impl, "allgather", None)
+    return native(data) if native is not None else allgather(impl, data)
+
+
+def _prefix_fold(items: List[Any], count: int, op: str) -> Any:
+    """Left fold of ``items[:count]`` in rank order — the combination
+    order shared by scan/exscan here and ``parallel.collectives.
+    prefix_reduce`` (bitwise contract across backends)."""
+    acc = items[0]
+    for i in range(1, count):
+        acc = combine(acc, items[i], op)
+    return acc
+
+
+def scan(impl: Interface, data: Any, op: str = "sum") -> Any:
+    """Inclusive prefix reduction: rank ``r`` returns
+    ``data_0 op data_1 op ... op data_r``, combined in rank order
+    (deterministic — the order IS the contract, like the binomial tree
+    for allreduce). Built on allgather so a backend's compiled gather
+    carries the communication; the per-rank prefix combine is local.
+    MPI_Scan parity — absent from the reference like every collective
+    (mpi.go:130)."""
+    check_op(op)
+    items = _allgather_best(impl, data)
+    return _prefix_fold(items, impl.rank() + 1, op)
+
+
+def exscan(impl: Interface, data: Any, op: str = "sum") -> Optional[Any]:
+    """Exclusive prefix reduction: rank ``r`` returns the combination of
+    ranks ``0..r-1``; rank 0 returns ``None`` (MPI_Exscan leaves its
+    buffer undefined there — None makes that explicit)."""
+    check_op(op)
+    me = impl.rank()
+    items = _allgather_best(impl, data)
+    return None if me == 0 else _prefix_fold(items, me, op)
 
 
 def barrier(impl: Interface) -> None:

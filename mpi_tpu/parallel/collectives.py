@@ -43,6 +43,7 @@ __all__ = [
     "allgather",
     "bcast",
     "alltoall",
+    "prefix_reduce",
     "pshift",
 ]
 
@@ -198,6 +199,41 @@ def alltoall(x: jnp.ndarray, axis_name: str = RANK_AXIS,
     AllToAll — the sequence-parallel (DeepSpeed-Ulysses style) primitive."""
     return lax.all_to_all(x, axis_name, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
+
+
+def prefix_reduce(x: jnp.ndarray, axis_name: str = RANK_AXIS,
+                  op: str = "sum", exclusive: bool = False) -> jnp.ndarray:
+    """Prefix reduction over the mesh axis in rank order — the jittable
+    MPI_Scan/Exscan: rank r returns ranks 0..r (inclusive) or 0..r-1
+    (``exclusive=True``; rank 0 gets the op's identity) combined.
+
+    all_gather + a sequential ``lax.scan`` left fold + a per-rank index:
+    the gather is the only communication, and the LEFT-FOLD combination
+    order is bitwise-identical to ``collectives_generic.scan``'s (the
+    order is the cross-backend contract, like tree_allreduce's); the
+    fold's n steps are over ranks, not elements — negligible."""
+    if op not in OPS:
+        raise ValueError(
+            f"mpi_tpu: unknown reduction op {op!r}; expected {OPS}")
+    stacked = lax.all_gather(x, axis_name, axis=0)
+
+    def step(acc, xi):
+        nacc = _combine(acc, xi, op)
+        return nacc, nacc
+
+    _, rest = lax.scan(step, stacked[0], stacked[1:])
+    prefix = jnp.concatenate([stacked[:1], rest], axis=0)
+    idx = lax.axis_index(axis_name)
+    if not exclusive:
+        return prefix[idx]
+    identity = {"sum": jnp.zeros_like(x),
+                "prod": jnp.ones_like(x),
+                "min": jnp.full_like(x, jnp.inf if jnp.issubdtype(
+                    x.dtype, jnp.floating) else jnp.iinfo(x.dtype).max),
+                "max": jnp.full_like(x, -jnp.inf if jnp.issubdtype(
+                    x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min)}
+    return jnp.where(idx == 0, identity[op],
+                     prefix[jnp.maximum(idx - 1, 0)])
 
 
 def pshift(x: jnp.ndarray, shift: int = 1,

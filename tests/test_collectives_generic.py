@@ -176,3 +176,35 @@ class TestBarrier:
 
         out = run_on_ranks(anycluster, body)
         assert len(set(out)) == 1
+
+
+class TestScan:
+    @pytest.mark.parametrize("op,reducer", [
+        ("sum", np.add), ("prod", np.multiply),
+        ("min", np.minimum), ("max", np.maximum)])
+    def test_scan_prefixes(self, anycluster, op, reducer):
+        n = len(anycluster)
+        rng = np.random.default_rng(11)
+        contribs = [rng.standard_normal((3, 4)) for _ in range(n)]
+        out = run_on_ranks(anycluster,
+                           lambda net, r: gen.scan(net, contribs[r], op=op))
+        for r in range(n):
+            expect = contribs[0]
+            for i in range(1, r + 1):
+                expect = reducer(expect, contribs[i])
+            np.testing.assert_allclose(out[r], expect, rtol=1e-12)
+
+    def test_exscan_rank0_none(self, anycluster):
+        n = len(anycluster)
+        out = run_on_ranks(anycluster,
+                           lambda net, r: gen.exscan(net, float(r + 1)))
+        assert out[0] is None
+        for r in range(1, n):
+            assert float(out[r]) == sum(range(1, r + 1))
+
+    def test_scan_scalars_rank_order(self, anycluster):
+        n = len(anycluster)
+        out = run_on_ranks(anycluster,
+                           lambda net, r: gen.scan(net, float(r + 1)))
+        assert [float(o) for o in out] == [
+            sum(range(1, r + 2)) for r in range(n)]

@@ -223,3 +223,41 @@ class TestHierarchicalAllreduce:
         got = fn(glob)
         np.testing.assert_allclose(
             np.asarray(got), np.concatenate([want] * N), rtol=1e-5)
+
+
+class TestPrefixReduce:
+    @pytest.mark.parametrize("op", ["sum", "prod", "min", "max"])
+    def test_inclusive_matches_numpy(self, op):
+        import numpy as np
+
+        from mpi_tpu.parallel import collectives as C
+        from mpi_tpu.parallel import make_mesh
+
+        n = 8
+        mesh = make_mesh(n)
+        x = np.random.default_rng(5).standard_normal((n, 4)).astype(
+            np.float32)
+        fn = jax.jit(jax.shard_map(
+            lambda y: C.prefix_reduce(y, "rank", op=op), mesh=mesh,
+            in_specs=P("rank"), out_specs=P("rank"), check_vma=False))
+        got = np.asarray(fn(x))
+        acc = {"sum": np.add, "prod": np.multiply,
+               "min": np.minimum, "max": np.maximum}[op].accumulate(x,
+                                                                    axis=0)
+        np.testing.assert_allclose(got, acc, rtol=1e-5)
+
+    def test_exclusive_rank0_identity(self):
+        import numpy as np
+
+        from mpi_tpu.parallel import collectives as C
+        from mpi_tpu.parallel import make_mesh
+
+        n = 4
+        mesh = make_mesh(n)
+        x = np.arange(n, dtype=np.float32).reshape(n, 1) + 1
+        fn = jax.jit(jax.shard_map(
+            lambda y: C.prefix_reduce(y, "rank", exclusive=True),
+            mesh=mesh, in_specs=P("rank"), out_specs=P("rank"),
+            check_vma=False))
+        got = np.asarray(fn(x))[:, 0]
+        np.testing.assert_allclose(got, [0.0, 1.0, 3.0, 6.0])

@@ -209,6 +209,21 @@ class TestCollectives:
             assert a2a == [f"{src}->{r}" for src in range(N)]
             assert ag == [i * 2 for i in range(N)]
 
+    def test_scan_exscan(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            inc = mpi_tpu.scan(np.float32(r + 1))
+            exc = mpi_tpu.exscan(np.float32(r + 1))
+            mx = mpi_tpu.scan(np.float32(r), op="max")
+            return float(inc), None if exc is None else float(exc), float(mx)
+
+        out = spmd(main)
+        for r, (inc, exc, mx) in enumerate(out):
+            assert inc == sum(range(1, r + 2))
+            assert (exc is None) if r == 0 else exc == sum(range(1, r + 1))
+            assert mx == r
+
     def test_reduce_root_only(self):
         def main():
             mpi_tpu.init()
