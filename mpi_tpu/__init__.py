@@ -37,6 +37,10 @@ from .api import (
     init,
     rank,
     receive,
+    Request,
+    isend,
+    irecv,
+    waitall,
     reduce,
     reduce_scatter,
     register,
@@ -69,6 +73,10 @@ __all__ = [
     "init",
     "rank",
     "receive",
+    "Request",
+    "isend",
+    "irecv",
+    "waitall",
     "reduce",
     "reduce_scatter",
     "register",

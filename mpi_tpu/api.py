@@ -50,6 +50,10 @@ __all__ = [
     "send",
     "receive",
     "sendrecv",
+    "Request",
+    "isend",
+    "irecv",
+    "waitall",
     "reduce",
     "allreduce",
     "reduce_scatter",
@@ -396,6 +400,93 @@ def alltoall(data: List[Any]) -> List[Any]:
     """Personalized all-to-all: element j of this rank's list goes to rank
     j; returns the list of payloads received, ordered by source rank."""
     return _collective("alltoall", data)
+
+
+class Request:
+    """Handle for a nonblocking operation — the async design the
+    reference sketches but never builds (the commented-out Send/Wait
+    pair at /root/reference/mpi.go:132-152). ``isend``/``irecv`` start
+    the blocking operation on a worker thread (the reference's
+    "callers use goroutines" doctrine made first-class) and return one
+    of these; ``wait()`` joins it, re-raising any error (including
+    ``TagError`` for a duplicate live ``{peer, tag}``) and returning
+    the received payload for receives. Once ``wait`` returns, the
+    ``{peer, tag}`` pair is free for reuse — exactly the contract the
+    sketch specifies."""
+
+    def __init__(self, fn):
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+
+        def run():
+            try:
+                self._result = fn()
+            except BaseException as exc:  # re-raised at wait()
+                self._exc = exc
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def test(self) -> bool:
+        """True once the operation has completed (without blocking).
+        Completion includes failure — ``wait`` reports which."""
+        return not self._thread.is_alive()
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        """Block until completion; return the received payload (None for
+        sends). Raises the operation's error, or ``MpiError`` on
+        timeout."""
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise MpiError(
+                f"mpi_tpu: Request.wait timed out after {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+def isend(data: Any, dest: int, tag: int) -> Request:
+    """Nonblocking send: returns immediately with a :class:`Request`;
+    ``wait()`` blocks until the receiver accepted the payload (the
+    rendezvous ack — the reference sketch's ``Wait``, mpi.go:145-151).
+
+    Routed through the facade's :func:`send` so peer validation and
+    trace accounting cover nonblocking traffic too (validation errors
+    surface at ``wait()``)."""
+    _require_init()
+    return Request(lambda: send(data, dest, tag))
+
+
+def irecv(source: int, tag: int, out: Optional[Any] = None) -> Request:
+    """Nonblocking receive: ``wait()`` returns the payload."""
+    _require_init()
+    return Request(lambda: receive(source, tag, out))
+
+
+def waitall(requests: List[Request],
+            timeout: Optional[float] = None) -> List[Any]:
+    """Wait on every request; results in order; first error re-raised.
+    ``timeout`` is a TOTAL deadline across the whole set — a hung
+    request makes the call raise after ~``timeout`` seconds, not
+    ``len(requests) * timeout`` (requests still running at the deadline
+    are reported in the error and keep their daemon worker threads)."""
+    import time as _time
+
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    results: List[Any] = []
+    first_exc: Optional[BaseException] = None
+    for req in requests:
+        left = None if deadline is None else max(
+            0.0, deadline - _time.monotonic())
+        try:
+            results.append(req.wait(left))
+        except BaseException as exc:
+            if first_exc is None:
+                first_exc = exc
+            results.append(None)
+    if first_exc is not None:
+        raise first_exc
+    return results
 
 
 def scan(data: Any, op: str = "sum") -> Any:

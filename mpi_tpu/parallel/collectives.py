@@ -226,13 +226,20 @@ def prefix_reduce(x: jnp.ndarray, axis_name: str = RANK_AXIS,
     idx = lax.axis_index(axis_name)
     if not exclusive:
         return prefix[idx]
-    identity = {"sum": jnp.zeros_like(x),
-                "prod": jnp.ones_like(x),
-                "min": jnp.full_like(x, jnp.inf if jnp.issubdtype(
-                    x.dtype, jnp.floating) else jnp.iinfo(x.dtype).max),
-                "max": jnp.full_like(x, -jnp.inf if jnp.issubdtype(
-                    x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min)}
-    return jnp.where(idx == 0, identity[op],
+    # Only op's identity is built — min/max identities need iinfo/inf,
+    # which would trace-fail for dtypes (bool, complex) where the OTHER
+    # ops are perfectly well-defined.
+    if op == "sum":
+        identity = jnp.zeros_like(x)
+    elif op == "prod":
+        identity = jnp.ones_like(x)
+    elif op == "min":
+        identity = jnp.full_like(x, jnp.inf if jnp.issubdtype(
+            x.dtype, jnp.floating) else jnp.iinfo(x.dtype).max)
+    else:  # "max" — op was validated at entry
+        identity = jnp.full_like(x, -jnp.inf if jnp.issubdtype(
+            x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min)
+    return jnp.where(idx == 0, identity,
                      prefix[jnp.maximum(idx - 1, 0)])
 
 

@@ -659,3 +659,24 @@ class TestCompiledCollectivePaths:
         assert ("bcast", "", False, 0) in net._jit_cache
         assert ("bcast", "", False, 1) in net._jit_cache
         assert ("allgather", "", False) in net._jit_cache
+
+
+class TestNonblocking:
+    def test_isend_irecv_inherits_rank_binding(self):
+        """Request worker threads must inherit the rank binding of the
+        rank thread that created them (the patched Thread.start), so the
+        facade's nonblocking ops work under thread-per-rank SPMD."""
+        def main():
+            mpi_tpu.init()
+            r, n = mpi_tpu.rank(), mpi_tpu.size()
+            right, left = (r + 1) % n, (r - 1) % n
+            rs = mpi_tpu.isend(np.full(3, r, np.float32), right, tag=11)
+            rr = mpi_tpu.irecv(left, tag=11)
+            got = rr.wait(timeout=20)
+            rs.wait(timeout=20)
+            return got
+
+        out = spmd(main)
+        for r in range(N):
+            np.testing.assert_array_equal(
+                out[r], np.full(3, (r - 1) % N, np.float32))
