@@ -485,6 +485,14 @@ def waitall(requests: List[Request],
                 first_exc = exc
             results.append(None)
     if first_exc is not None:
+        pending = [i for i, r in enumerate(requests) if not r.test()]
+        if pending:
+            exc = MpiError(
+                f"mpi_tpu: waitall deadline expired with "
+                f"{len(pending)}/{len(requests)} requests still running "
+                f"(indices {pending})")
+            exc.partial_results = results
+            raise exc from first_exc
         raise first_exc
     return results
 

@@ -381,12 +381,19 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
 def loss_fn(params, tokens, cfg: TransformerConfig,
             mesh: Optional[Mesh] = None) -> jax.Array:
     """Next-token cross-entropy (mean over all predicted positions), plus
-    the MoE load-balance penalty when experts are enabled."""
+    the MoE load-balance penalty when experts are enabled.
+
+    Written as ``logsumexp - target_logit`` rather than gathering from a
+    materialised ``log_softmax``: the full (batch, seq, vocab) float32
+    log-prob tensor never exists, saving its HBM round-trips at large
+    vocab (the backward of logsumexp produces the softmax directly)."""
     logits, aux = forward_with_aux(params, tokens[:, :-1], cfg, mesh)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll) + cfg.moe_aux_coef * aux
+    logits32 = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits32, axis=-1)
+    tgt = jnp.take_along_axis(logits32, targets[..., None],
+                              axis=-1)[..., 0]
+    return jnp.mean(lse - tgt) + cfg.moe_aux_coef * aux
 
 
 # --------------------------------------------------------------------------

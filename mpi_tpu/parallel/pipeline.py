@@ -33,7 +33,7 @@ __all__ = ["pipeline", "pipeline_sharded"]
 
 def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
              stage_params: Any, xs: jax.Array,
-             axis_name: str = "pp") -> jax.Array:
+             axis_name: str = "pp", remat_stage: bool = False) -> jax.Array:
     """Per-device body: stream microbatches through the stage ring.
 
     Must be traced over ``axis_name`` (inside shard_map/pmap).
@@ -44,6 +44,12 @@ def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
     ``xs`` is ``(M, ...)`` microbatched input, present on stage 0
     (replication is fine — other stages' copies are ignored).
 
+    ``remat_stage=True`` wraps the stage in ``jax.checkpoint`` so the
+    backward pass recomputes each (stage, microbatch) forward instead of
+    storing its internals — per-device residuals drop from
+    O(steps · stage_internals) to O(steps · activation), the lever that
+    matters because the fill-drain scan holds every step's residuals.
+
     Returns ``(M, ...)`` outputs, valid on the **last** stage and
     broadcast to every stage for convenience.
     """
@@ -51,6 +57,8 @@ def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
     s = lax.axis_index(axis_name)
     m_total = xs.shape[0]
     steps = m_total + n - 1
+    if remat_stage:
+        stage_fn = jax.checkpoint(stage_fn)
 
     def step(carry, t):
         arriving = carry  # activation handed to us by the previous stage
@@ -80,7 +88,8 @@ def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
 def pipeline_sharded(stage_fn: Callable[[Any, jax.Array], jax.Array],
                      stacked_params: Any, xs: jax.Array, mesh,
                      axis_name: str = "pp",
-                     extra_param_spec: Optional[P] = None) -> jax.Array:
+                     extra_param_spec: Optional[P] = None,
+                     remat_stage: bool = False) -> jax.Array:
     """shard_map wrapper: ``stacked_params`` leaves carry a leading stage
     axis of size ``mesh.shape[axis_name]`` (stage i's slice on device i);
     ``xs`` is the global ``(M, ...)`` microbatch stack, replicated."""
@@ -91,7 +100,8 @@ def pipeline_sharded(stage_fn: Callable[[Any, jax.Array], jax.Array],
     def body(params, xs_local):
         # shard_map gives each device a (1, ...) slice; drop the axis.
         own = jax.tree.map(lambda p: p[0], params)
-        return pipeline(stage_fn, own, xs_local, axis_name=axis_name)
+        return pipeline(stage_fn, own, xs_local, axis_name=axis_name,
+                        remat_stage=remat_stage)
 
     pspec = extra_param_spec or P(axis_name)
     fn = jax.shard_map(
