@@ -24,6 +24,12 @@ from .transformer import (
 )
 from .moe import init_moe_params, moe_ffn, moe_specs
 from .generate import decode_step, generate, prefill
+from .pipeline_lm import (
+    forward_pipelined,
+    init_pipelined_params,
+    make_pipelined_train_step,
+    stack_block_params,
+)
 
 __all__ = [
     "TransformerConfig",
@@ -43,4 +49,8 @@ __all__ = [
     "prefill",
     "decode_step",
     "generate",
+    "forward_pipelined",
+    "init_pipelined_params",
+    "make_pipelined_train_step",
+    "stack_block_params",
 ]

@@ -26,6 +26,7 @@ reference's ``bounce`` example exercises Send/Receive
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, Optional, Tuple
 
@@ -338,6 +339,35 @@ def _ffn(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh]):
     return y, jnp.zeros((), jnp.float32)
 
 
+def block_body(x, blk, cfg: TransformerConfig,
+               mesh: Optional[Mesh] = None):
+    """ONE transformer block (pre-norm attention + FFN residuals) —
+    the single definition shared by the sequential stack
+    (:func:`forward_with_aux`) and the pipelined stages
+    (:mod:`mpi_tpu.models.pipeline_lm`), so the two paths cannot
+    drift. Returns ``(x, aux_loss)``."""
+    h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
+                   blk["ln1"]["bias"].astype(x.dtype))
+    x = x + _attention(h, blk, cfg, mesh)
+    x = _act_constraint(x, mesh)
+    h = _layernorm(x, blk["ln2"]["scale"].astype(x.dtype),
+                   blk["ln2"]["bias"].astype(x.dtype))
+    y, blk_aux = _ffn(h, blk, cfg, mesh)
+    x = x + y
+    return _act_constraint(x, mesh), blk_aux
+
+
+def token_xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy as ``logsumexp - target_logit`` —
+    the fused form that never materialises the (b, s, vocab) float32
+    log-softmax. Shared by the sequential and pipelined losses."""
+    logits32 = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits32, axis=-1)
+    tgt = jnp.take_along_axis(logits32, targets[..., None],
+                              axis=-1)[..., 0]
+    return jnp.mean(lse - tgt)
+
+
 def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
                      cfg: TransformerConfig,
                      mesh: Optional[Mesh] = None
@@ -351,17 +381,7 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
     x = _act_constraint(x, mesh)
     aux = jnp.zeros((), jnp.float32)
 
-    def block(x, blk):
-        h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
-                       blk["ln1"]["bias"].astype(x.dtype))
-        x = x + _attention(h, blk, cfg, mesh)
-        x = _act_constraint(x, mesh)
-        h = _layernorm(x, blk["ln2"]["scale"].astype(x.dtype),
-                       blk["ln2"]["bias"].astype(x.dtype))
-        y, blk_aux = _ffn(h, blk, cfg, mesh)
-        x = x + y
-        return _act_constraint(x, mesh), blk_aux
-
+    block = functools.partial(block_body, cfg=cfg, mesh=mesh)
     if cfg.remat:
         block = jax.checkpoint(block)
     for blk in params["blocks"]:
@@ -388,12 +408,7 @@ def loss_fn(params, tokens, cfg: TransformerConfig,
     log-prob tensor never exists, saving its HBM round-trips at large
     vocab (the backward of logsumexp produces the softmax directly)."""
     logits, aux = forward_with_aux(params, tokens[:, :-1], cfg, mesh)
-    targets = tokens[:, 1:]
-    logits32 = logits.astype(jnp.float32)
-    lse = jax.nn.logsumexp(logits32, axis=-1)
-    tgt = jnp.take_along_axis(logits32, targets[..., None],
-                              axis=-1)[..., 0]
-    return jnp.mean(lse - tgt) + cfg.moe_aux_coef * aux
+    return token_xent(logits, tokens[:, 1:]) + cfg.moe_aux_coef * aux
 
 
 # --------------------------------------------------------------------------
