@@ -24,7 +24,12 @@ star, BASELINE.json:5 — null when only one chip is visible, because a
 1-device psum is the identity; the ``_cpu8mesh`` twin then carries the
 multi-device collective measured on a virtual 8-device mesh),
 ``bounce_tcp_us`` / ``bounce_xla_us`` / ``bounce_speedup`` (reference
-method, both sides measured same-machine same-run), and provenance
+method, both sides measured same-machine same-run),
+``bounce_device_us`` (the same ping-pong with a committed device-array
+payload riding the DevicePipe's compiled ppermute p2p between two
+distinct devices of a virtual mesh — no host round-trip of the bytes),
+``decode_tokens_per_s`` (KV-cache greedy decode of the same flagship —
+the serving-side twin of the training headline), and provenance
 (device kind, peak TFLOP/s used, model shape).
 
 Timing method: the TPU here sits behind a tunnel with a large fixed
@@ -216,6 +221,53 @@ def measure_long_context(seq: int = 8192, d_model: int = 1024,
     }
 
 
+def measure_decode(d_model: int = 1024, n_layers: int = 8, n_heads: int = 8,
+                   d_ff: int = 4096, vocab: int = 8192, batch: int = 8,
+                   prompt_len: int = 128, short: int = 16, long: int = 128
+                   ) -> dict:
+    """Inference throughput: greedy KV-cache decode of the flagship model
+    (models/generate.py — prefill then one ``lax.scan`` over decode
+    steps, all compiled). Per-token time differences a ``long``- and
+    ``short``-token generate program so fixed dispatch/tunnel latency
+    cancels, same method as the train-step timing. Reports decoded
+    tokens/s across the batch — the serving-side twin of the training
+    headline (no reference analogue; btracey/mpi has no models)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_tpu.models import TransformerConfig, generate, init_params
+
+    cfg = TransformerConfig(
+        vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        d_ff=d_ff, max_seq=prompt_len + long, dtype=jnp.bfloat16,
+        attention_impl="dense")  # decode attends via the cache, not flash
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, vocab, (batch, prompt_len)),
+        dtype=jnp.int32)
+
+    def run(n):
+        return jax.jit(lambda p: generate(params, p, cfg, n)[:, -1].sum())
+
+    run_short, run_long = run(short), run(long)
+    int(run_short(prompt)); int(run_long(prompt))  # compile + warm
+    t_short = _median_time(lambda: int(run_short(prompt)))
+    t_long = _median_time(lambda: int(run_long(prompt)))
+    per_tok = (t_long - t_short) / (long - short)
+    timing_method = "differenced"
+    if per_tok <= 0:
+        per_tok = t_long / long
+        timing_method = "fallback_total_over_n"
+    return {
+        "decode_ms_per_token": round(per_tok * 1e3, 3),
+        "decode_tokens_per_s": round(batch / per_tok),
+        "decode_batch": batch,
+        "decode_prompt_len": prompt_len,
+        "decode_timing_method": timing_method,
+    }
+
+
 # --------------------------------------------------------------------------
 # Allreduce north star (BASELINE.json:5)
 # --------------------------------------------------------------------------
@@ -381,6 +433,72 @@ def bounce_xla(size: int = BOUNCE_SIZE) -> float:
     return 1e6 * sum(times) / len(times)
 
 
+def _bounce_device_child(size: int = BOUNCE_SIZE) -> int:
+    """Subprocess leg: device-array ping-pong between 2 ranks on 2
+    *distinct* devices of a virtual 8-device CPU mesh. The payload is a
+    committed single-device jax.Array, so the facade's send() lowers to
+    the DevicePipe's compiled ppermute program (parallel/p2p.py) — the
+    tagged-p2p data path with no host round-trip of the payload — and
+    each round-trip is two compiled ICI hops plus the rendezvous
+    handshake. Prints mean round-trip µs as JSON."""
+    from mpi_tpu.utils.platform import force_platform
+
+    force_platform("cpu", 8)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi_tpu
+    from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+    elems = max(1, size // 4)
+    base = jnp.asarray(
+        np.random.default_rng(7).standard_normal(elems), jnp.float32)
+    times: list = []
+
+    def main():
+        mpi_tpu.init()
+        r = mpi_tpu.rank()
+        msg = jax.device_put(base, jax.devices()[0]) if r == 0 else None
+        for i in range(BOUNCE_WARMUP + BOUNCE_REPS):
+            if r == 0:
+                t0 = time.perf_counter()
+                mpi_tpu.send(msg, 1, i)
+                echo = mpi_tpu.receive(source=1, tag=i)
+                dt = time.perf_counter() - t0
+                if not isinstance(echo, jax.Array) or \
+                        not bool(jnp.array_equal(echo, msg)):
+                    raise RuntimeError("device bounce echo mismatch")
+                if i >= BOUNCE_WARMUP:
+                    times.append(dt)
+            else:
+                got = mpi_tpu.receive(source=0, tag=i)
+                mpi_tpu.send(got, 0, i)
+        mpi_tpu.finalize()
+
+    run_spmd(main, net=XlaNetwork(n=2))
+    print(json.dumps(
+        {"bounce_device_us": round(1e6 * sum(times) / len(times), 1),
+         "bounce_device_bytes": elems * 4}))
+    return 0
+
+
+def bounce_device(size: int = BOUNCE_SIZE) -> dict:
+    """Run the device-array bounce in a subprocess (it needs a multi-
+    device platform pinned before JAX initializes) and return its keys."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--_bounce-device-child", str(size)],
+        capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError(f"device bounce child failed: "
+                           f"{proc.stderr[-500:]}")
+    return json.loads(
+        [l for l in proc.stdout.splitlines() if l.startswith("{")][-1])
+
+
 def _bounce_tcp_child() -> int:
     """Child rank of the TCP bounce (spawned via the real launcher ABI:
     --mpi-addr/--mpi-alladdr flags injected by launch())."""
@@ -446,6 +564,9 @@ def _allreduce_on_virtual_mesh(size_bytes: int) -> dict:
 def main() -> int:
     if "--_bounce-child" in sys.argv:
         return _bounce_tcp_child()
+    if "--_bounce-device-child" in sys.argv:
+        idx = sys.argv.index("--_bounce-device-child")
+        return _bounce_device_child(int(sys.argv[idx + 1]))
     if "--_allreduce-child" in sys.argv:
         idx = sys.argv.index("--_allreduce-child")
         return _allreduce_child(int(sys.argv[idx + 1]))
@@ -472,6 +593,7 @@ def main() -> int:
     # TCP bounce first: subprocesses, no device contention with the rest.
     tcp_us = bounce_tcp()
     xla_us = bounce_xla()
+    dev_bounce = bounce_device((1 << 14) if smoke else BOUNCE_SIZE)
     ar_size = (1 << 20) if smoke else (256 << 20)
     if smoke:
         result = measure_train_step(d_model=64, n_layers=2, n_heads=4,
@@ -480,9 +602,13 @@ def main() -> int:
         result.update(measure_long_context(
             seq=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
             vocab=128, short=1, long=3))
+        result.update(measure_decode(
+            d_model=64, n_layers=2, n_heads=4, d_ff=128, vocab=128,
+            batch=2, prompt_len=16, short=4, long=12))
     else:
         result = measure_train_step()
         result.update(measure_long_context())
+        result.update(measure_decode())
     ar = measure_allreduce(ar_size)
     if ar.get("allreduce_devices") == 1:
         # Single chip: the in-process collective is the identity (keys
@@ -495,6 +621,7 @@ def main() -> int:
         "bounce_xla_us": round(xla_us, 1),
         "bounce_speedup": round(tcp_us / xla_us, 1),
     })
+    result.update(dev_bounce)
     if "--suite" in sys.argv:
         allreduce_sweep()
 

@@ -20,6 +20,7 @@ point-to-point library over TCP, /root/reference) designed TPU-first:
     ted SPMD code, plus Pallas ring/DMA kernels (:mod:`mpi_tpu.ops`).
 """
 
+from .comm import Comm, comm_world
 from .runner import run_main, selected_backend
 from .api import (
     Interface,
@@ -56,6 +57,8 @@ from .api import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "Comm",
+    "comm_world",
     "run_main",
     "selected_backend",
     "Interface",

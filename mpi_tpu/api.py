@@ -231,6 +231,7 @@ def send(data: Any, dest: int, tag: int) -> None:
     ``dest`` has accepted the message (network.go:569,617-624)."""
     impl = _require_init()
     _check_peer(dest, impl)
+    _check_tag(tag)
     from .utils import trace
 
     if not trace.enabled():
@@ -250,6 +251,7 @@ def receive(source: int, tag: int, out: Optional[Any] = None) -> Any:
     reuse semantics (mpi.go:84-90)."""
     impl = _require_init()
     _check_peer(source, impl)
+    _check_tag(tag)
     from .utils import trace
 
     if not trace.enabled():
@@ -307,6 +309,7 @@ def sendrecv(data: Any, dest: int, source: int, tag: int,
     impl = _require_init()
     _check_peer(dest, impl)
     _check_peer(source, impl)
+    _check_tag(tag)
     from .utils import trace
 
     if not trace.enabled():
@@ -327,6 +330,17 @@ def _check_peer(peer: int, impl: Interface) -> None:
     n = impl.size()
     if not 0 <= peer < n:
         raise MpiError(f"mpi_tpu: peer rank {peer} out of range [0, {n})")
+
+
+def _check_tag(tag: int) -> None:
+    """World traffic owns the non-negative tag space; the negative half
+    is reserved for sub-communicator context regions
+    (:mod:`mpi_tpu.comm`), so a negative world tag could capture — or be
+    captured by — another communicator's traffic."""
+    if tag < 0:
+        raise MpiError(
+            f"mpi_tpu: tag {tag} is negative; the negative tag space is "
+            f"reserved for sub-communicator contexts (mpi_tpu.comm)")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,361 @@
+"""Communicators — rank groups with isolated tag spaces (MPI_Comm).
+
+The reference has exactly one implicit communicator: the whole world
+(``Rank()``/``Size()`` address every process, mpi.go:112-119; every
+``Send``/``Receive`` peer is a world rank, mpi.go:126-159). This module is
+framework-completeness work with no reference analogue: it supplies the
+``MPI_Comm_split`` / ``MPI_Comm_dup`` surface an MPI user expects —
+ordered sub-groups with their own dense rank numbering, their own
+collectives, and *context isolation* so traffic on one communicator can
+never be captured by a matching ``{peer, tag}`` pair on another.
+
+Design (tpu-first, but transport-agnostic):
+
+* A :class:`Comm` implements the backend SPI (rank/size/send/receive) by
+  translating group ranks to world ranks and mapping tags into a
+  per-communicator **context region** of the 64-bit tag space, then
+  delegating to the underlying driver. Every facility built on the SPI —
+  the generic collectives (:mod:`mpi_tpu.collectives_generic`), the
+  concurrent-exchange engine (:func:`mpi_tpu.api.exchange`), nonblocking
+  requests — therefore works on a sub-communicator unchanged, over any
+  driver (tcp, xla, hybrid). On the xla driver, array payloads inside a
+  group still ride the DevicePipe's compiled device-to-device transfers.
+
+* **Context ids are negotiated, not hashed** (the approach real MPI
+  implementations use): ``split`` runs a max-allreduce of every member's
+  context high-water mark over the *parent* communicator, and the
+  agreed ``max + 1`` becomes the child's context. Any two communicators
+  that share a pair of ranks are therefore guaranteed distinct contexts
+  (the shared member's high-water mark makes the later negotiation bid
+  higher); disjoint communicators may reuse a context, which is safe
+  because tag collision requires a shared ``{src, dst}`` link. The one
+  rule inherited from this scheme: a rank must not run two ``split``
+  calls concurrently on *overlapping* communicators (MPI imposes the
+  same ordering requirement for collectives on a given communicator).
+
+* **Tag layout**: world traffic uses non-negative tags (user tags below
+  ``collectives_generic.COLL_TAG_BASE``, collective rounds above it).
+  Communicator traffic uses the negative half of the i64 tag space —
+  context ``c`` owns ``[-(c+1)*2^44, -c*2^44)`` — so no communicator tag
+  can ever collide with world traffic, and the TCP wire format's i64 tag
+  field (backends/tcp.py frame header) carries it unchanged. Within a
+  region, user tags occupy the low ``2^40`` offsets and collective
+  rounds the rest.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Tuple
+
+from .api import Interface, MpiError, Request, exchange as _exchange
+
+__all__ = ["Comm", "comm_world", "CTX_SPAN", "USER_TAG_SPAN"]
+
+CTX_SPAN = 1 << 44        # tag-space region per context
+USER_TAG_SPAN = 1 << 40   # user tags within a region: [0, 2^40)
+
+_ctx_lock = threading.Lock()
+
+
+class _CollState:
+    """Collective tag-sequence state for one ``(rank, context)``.
+
+    ``collectives_generic._next_tag_base`` reads/writes ``_coll_lock`` /
+    ``_coll_seq`` attributes on whatever impl it is handed. Storing them
+    on a :class:`Comm` instance would reset the sequence whenever the
+    user constructs a second Comm for the same group (e.g. calling
+    ``comm_world()`` twice), desynchronizing tag blocks across ranks
+    that cache communicators differently. Instead every Comm for a given
+    ``(rank, ctx)`` shares one of these, registered on the *driver* —
+    and it is keyed by rank (not just ctx) because under thread-per-rank
+    drivers (xla) all ranks share one driver object while each rank must
+    allocate the sequence 0, 1, 2, ... independently."""
+
+    __slots__ = ("_coll_lock", "_coll_seq")
+
+    def __init__(self) -> None:
+        self._coll_lock = threading.Lock()
+        self._coll_seq = 0
+
+
+def _ctx_high(impl: Interface) -> int:
+    """This process's context high-water mark (0 = only the world ctx)."""
+    return getattr(impl, "_comm_ctx_high", 0)
+
+
+def _raise_ctx_high(impl: Interface, ctx: int) -> None:
+    with _ctx_lock:
+        if ctx > getattr(impl, "_comm_ctx_high", 0):
+            setattr(impl, "_comm_ctx_high", ctx)
+
+
+def _propose_ctx(impl: Interface) -> int:
+    """Atomically reserve the next context bid for a split in flight, so
+    two concurrent splits from this process never bid the same value."""
+    with _ctx_lock:
+        bid = getattr(impl, "_comm_ctx_high", 0) + 1
+        setattr(impl, "_comm_ctx_high", bid)
+        return bid
+
+
+class Comm:
+    """An ordered group of world ranks with its own rank numbering, tag
+    space, and collectives. Implements the backend SPI (over translated
+    ranks/tags), so it can be passed anywhere an ``Interface`` goes.
+
+    Obtain the root via :func:`comm_world`; derive sub-communicators with
+    :meth:`split` / :meth:`dup`. All SPI calls require the underlying
+    driver to be initialized (``mpi_tpu.init()``).
+    """
+
+    def __init__(self, impl: Interface, members: Tuple[int, ...], ctx: int):
+        if ctx < 0:
+            raise MpiError(f"mpi_tpu: negative comm context {ctx}")
+        if len(set(members)) != len(members):
+            raise MpiError(f"mpi_tpu: duplicate world ranks in comm "
+                           f"members {members}")
+        self._impl = impl
+        self._members = tuple(int(m) for m in members)
+        self._ctx = int(ctx)
+        self._world_to_group = {w: g for g, w in enumerate(self._members)}
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def context(self) -> int:
+        """The negotiated context id (0 = the world communicator)."""
+        return self._ctx
+
+    @property
+    def members(self) -> Tuple[int, ...]:
+        """World ranks of this group, ordered by group rank."""
+        return self._members
+
+    def translate(self, group_rank: int) -> int:
+        """World rank of ``group_rank`` (MPI_Group_translate_ranks)."""
+        self._check_peer(group_rank)
+        return self._members[group_rank]
+
+    def __repr__(self) -> str:
+        return (f"Comm(ctx={self._ctx}, size={len(self._members)}, "
+                f"members={self._members})")
+
+    # -- SPI ---------------------------------------------------------------
+
+    def init(self) -> None:
+        raise MpiError("mpi_tpu: a Comm does not own the network; call "
+                       "mpi_tpu.init() on the driver instead")
+
+    def finalize(self) -> None:
+        raise MpiError("mpi_tpu: a Comm does not own the network; call "
+                       "mpi_tpu.finalize() on the driver instead")
+
+    def rank(self) -> int:
+        """This process's rank within the group."""
+        w = self._impl.rank()
+        g = self._world_to_group.get(w)
+        if g is None:
+            raise MpiError(
+                f"mpi_tpu: world rank {w} is not a member of {self!r}")
+        return g
+
+    def size(self) -> int:
+        return len(self._members)
+
+    def send(self, data: Any, dest: int, tag: int) -> None:
+        """Blocking rendezvous send to group rank ``dest``."""
+        self._check_peer(dest)
+        self._impl.send(data, self._members[dest], self._map_tag(tag))
+
+    def receive(self, source: int, tag: int, out: Optional[Any] = None) -> Any:
+        """Blocking receive from group rank ``source``."""
+        self._check_peer(source)
+        return self._impl.receive(self._members[source], self._map_tag(tag),
+                                  out=out)
+
+    def cancel_receive(self, source: int, tag: int) -> bool:
+        """Forwarded so :func:`mpi_tpu.api.exchange` can clean up a posted
+        receive when its paired send fails (drivers without support are
+        detected by the engine via getattr, so only forward if present)."""
+        cancel = getattr(self._impl, "cancel_receive", None)
+        if cancel is None:
+            raise AttributeError("underlying driver has no cancel_receive")
+        self._check_peer(source)
+        return cancel(self._members[source], self._map_tag(tag))
+
+    def sendrecv(self, data: Any, dest: int, source: int, tag: int,
+                 out: Optional[Any] = None) -> Any:
+        """Concurrent send+receive within the group (deadlock-free where
+        sequential send-then-receive would rendezvous-deadlock)."""
+        self._check_peer(dest)
+        self._check_peer(source)
+        return _exchange(self, data, dest, source, tag, out=out)
+
+    def isend(self, data: Any, dest: int, tag: int) -> Request:
+        """Nonblocking group send; ``wait()`` blocks until the rendezvous
+        ack (same contract as :func:`mpi_tpu.isend`)."""
+        return Request(lambda: self.send(data, dest, tag))
+
+    def irecv(self, source: int, tag: int, out: Optional[Any] = None
+              ) -> Request:
+        """Nonblocking group receive; ``wait()`` returns the payload."""
+        return Request(lambda: self.receive(source, tag, out=out))
+
+    # -- tag mapping -------------------------------------------------------
+
+    def _map_tag(self, tag: int) -> int:
+        from .collectives_generic import COLL_TAG_BASE
+
+        if self._ctx == 0:
+            # World comm: the driver's tag space verbatim — but never the
+            # negative half, which belongs to sub-communicator contexts
+            # (a negative world tag could forge a context-region tag and
+            # capture another communicator's traffic).
+            if tag < 0:
+                raise MpiError(
+                    f"mpi_tpu: tag {tag} is negative; the negative tag "
+                    f"space is reserved for sub-communicator contexts")
+            return tag
+        if 0 <= tag < USER_TAG_SPAN:
+            offset = tag
+        elif tag >= COLL_TAG_BASE:
+            offset = USER_TAG_SPAN + (tag - COLL_TAG_BASE)
+            if offset >= CTX_SPAN:
+                raise MpiError(
+                    "mpi_tpu: communicator collective tag space exhausted")
+        else:
+            raise MpiError(
+                f"mpi_tpu: tag {tag} out of range for a sub-communicator "
+                f"(user tags must be in [0, 2^40))")
+        return -((self._ctx + 1) * CTX_SPAN) + offset
+
+    def _check_peer(self, peer: int) -> None:
+        n = len(self._members)
+        if not 0 <= peer < n:
+            raise MpiError(
+                f"mpi_tpu: group rank {peer} out of range [0, {n})")
+
+    # -- collective tag-sequence state (see _CollState) --------------------
+
+    def _coll_state(self) -> _CollState:
+        key = (self._impl.rank(), self._ctx)
+        with _ctx_lock:
+            states = self._impl.__dict__.setdefault("_comm_coll_states", {})
+            st = states.get(key)
+            if st is None:
+                st = states[key] = _CollState()
+        return st
+
+    # collectives_generic._next_tag_base reads/writes these attributes on
+    # the impl it is handed; proxy them to the shared per-(rank, ctx)
+    # state so every Comm instance for the same group stays in lockstep.
+    @property
+    def _coll_lock(self) -> threading.Lock:
+        return self._coll_state()._coll_lock
+
+    @property
+    def _coll_seq(self) -> int:
+        return self._coll_state()._coll_seq
+
+    @_coll_seq.setter
+    def _coll_seq(self, value: int) -> None:
+        self._coll_state()._coll_seq = value
+
+    # -- collectives -------------------------------------------------------
+    #
+    # Context 0 (world) has the driver's exact membership and tag space,
+    # so it dispatches like the facade: the driver's native collective
+    # (e.g. the xla driver's compiled XLA programs) when present, else
+    # the generic algorithm over the DRIVER — sharing the driver's tag
+    # sequence with facade-level collectives. Sub-communicators run the
+    # generic algorithms over the translated SPI (self).
+
+    def _coll(self, name: str, *args: Any, **kwargs: Any) -> Any:
+        from . import collectives_generic as gen
+
+        if self._ctx == 0:
+            native = getattr(self._impl, name, None)
+            if native is not None:
+                return native(*args, **kwargs)
+            return getattr(gen, name)(self._impl, *args, **kwargs)
+        return getattr(gen, name)(self, *args, **kwargs)
+
+    def allreduce(self, data: Any, op: str = "sum") -> Any:
+        return self._coll("allreduce", data, op=op)
+
+    def reduce(self, data: Any, root: int = 0, op: str = "sum") -> Optional[Any]:
+        return self._coll("reduce", data, root=root, op=op)
+
+    def reduce_scatter(self, data: Any, op: str = "sum") -> Any:
+        return self._coll("reduce_scatter", data, op=op)
+
+    def bcast(self, data: Any, root: int = 0) -> Any:
+        return self._coll("bcast", data, root=root)
+
+    def gather(self, data: Any, root: int = 0) -> Optional[List[Any]]:
+        return self._coll("gather", data, root=root)
+
+    def allgather(self, data: Any) -> List[Any]:
+        return self._coll("allgather", data)
+
+    def scatter(self, data: Optional[List[Any]], root: int = 0) -> Any:
+        return self._coll("scatter", data, root=root)
+
+    def alltoall(self, data: List[Any]) -> List[Any]:
+        return self._coll("alltoall", data)
+
+    def scan(self, data: Any, op: str = "sum") -> Any:
+        return self._coll("scan", data, op=op)
+
+    def exscan(self, data: Any, op: str = "sum") -> Optional[Any]:
+        return self._coll("exscan", data, op=op)
+
+    def barrier(self) -> None:
+        return self._coll("barrier")
+
+    # -- construction ------------------------------------------------------
+
+    def split(self, color: Optional[int], key: int = 0) -> Optional["Comm"]:
+        """Partition this communicator (MPI_Comm_split semantics).
+
+        Collective: **every** member must call it. Members with the same
+        ``color`` form a new communicator, ranked by ``(key, rank in
+        self)``; ``color=None`` (MPI_UNDEFINED) participates in the
+        exchange but gets ``None`` back.
+        """
+        me = self.rank()
+        # One collective exchange serves both membership and the context
+        # negotiation: each member contributes (color, key, ctx bid). The
+        # bid is reserved up front so concurrent splits from one process
+        # bid distinct values; the agreed context is the max bid, which
+        # every member then records as its new high-water mark.
+        bid = _propose_ctx(self._impl)
+        entries = self.allgather((color, key, bid))
+        new_ctx = max(int(e[2]) for e in entries)
+        _raise_ctx_high(self._impl, new_ctx)
+        if color is None:
+            return None
+        group = sorted(
+            (int(e[1]), r) for r, e in enumerate(entries) if e[0] == color)
+        members = tuple(self._members[r] for _, r in group)
+        child = Comm(self._impl, members, new_ctx)
+        assert child._world_to_group.get(self._members[me]) is not None
+        return child
+
+    def dup(self) -> "Comm":
+        """A communicator with identical membership and ordering but a
+        fresh context — isolates library traffic (MPI_Comm_dup)."""
+        child = self.split(color=0, key=self.rank())
+        assert child is not None
+        return child
+
+
+def comm_world(impl: Optional[Interface] = None) -> Comm:
+    """The world communicator over the active (or given) driver: every
+    rank, identity numbering, context 0 (driver tag space verbatim)."""
+    from . import api
+
+    if impl is None:
+        impl = api._require_init()
+    return Comm(impl, tuple(range(impl.size())), 0)

@@ -1,0 +1,398 @@
+"""Communicator tests: split/dup semantics, tag isolation, group
+collectives over both the xla and tcp drivers."""
+
+import numpy as np
+import pytest
+
+import mpi_tpu
+from mpi_tpu import api
+from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+from mpi_tpu.comm import CTX_SPAN, USER_TAG_SPAN, Comm, comm_world
+
+from conftest import run_on_ranks, tcp_cluster
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    api._reset_for_testing()
+    yield
+    api._reset_for_testing()
+
+
+def spmd(fn, n=N, **kw):
+    return run_spmd(fn, n=n, **kw)
+
+
+class TestWorld:
+    def test_world_identity(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = (w.rank(), w.size(), w.context, w.members)
+            mpi_tpu.finalize()
+            return r
+
+        out = spmd(main, n=4)
+        assert [o[0] for o in out] == [0, 1, 2, 3]
+        assert all(o[1] == 4 for o in out)
+        assert all(o[2] == 0 for o in out)
+        assert all(o[3] == (0, 1, 2, 3) for o in out)
+
+    def test_world_p2p_and_collectives_match_facade(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            got = None
+            if r == 0:
+                w.send(b"ping", 1, 7)
+            elif r == 1:
+                got = w.receive(0, 7)
+            total = w.allreduce(np.float64(r))
+            mpi_tpu.finalize()
+            return got, float(total)
+
+        out = spmd(main, n=4)
+        assert out[1][0] == b"ping"
+        assert all(o[1] == 6.0 for o in out)
+
+    def test_comm_does_not_own_lifecycle(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            try:
+                with pytest.raises(mpi_tpu.MpiError, match="does not own"):
+                    w.init()
+                with pytest.raises(mpi_tpu.MpiError, match="does not own"):
+                    w.finalize()
+            finally:
+                mpi_tpu.finalize()
+
+        spmd(main, n=2)
+
+
+class TestSplit:
+    def test_even_odd_split(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            sub = w.split(color=r % 2)
+            res = (sub.rank(), sub.size(), sub.context, sub.members,
+                   float(sub.allreduce(np.float64(r))))
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main)
+        evens = tuple(range(0, N, 2))
+        odds = tuple(range(1, N, 2))
+        for r, (grank, gsize, ctx, members, total) in enumerate(out):
+            assert gsize == N // 2
+            assert members == (evens if r % 2 == 0 else odds)
+            assert grank == members.index(r)
+            assert ctx >= 1  # non-world context
+            assert total == float(sum(members))
+        # Both halves negotiate in the same collective: same context is
+        # fine (disjoint membership shares no {src, dst} link).
+        assert len({o[2] for o in out}) == 1
+
+    def test_key_reorders_ranks(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            sub = w.split(color=0, key=-r)  # reversed order
+            res = (sub.rank(), sub.members)
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main, n=4)
+        assert all(o[1] == (3, 2, 1, 0) for o in out)
+        assert [o[0] for o in out] == [3, 2, 1, 0]
+
+    def test_color_none_gets_none(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            sub = w.split(color=0 if r < 2 else None)
+            res = None if sub is None else (sub.rank(), sub.size())
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main, n=4)
+        assert out[0] == (0, 2) and out[1] == (1, 2)
+        assert out[2] is None and out[3] is None
+
+    def test_nested_split_and_ctx_monotone(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            half = w.split(color=r // 4)       # {0-3}, {4-7}
+            quarter = half.split(color=half.rank() // 2)  # pairs
+            res = (half.context, quarter.context, quarter.members,
+                   float(quarter.allreduce(np.float64(r))))
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main)
+        for r, (hctx, qctx, qmembers, total) in enumerate(out):
+            assert qctx > hctx >= 1  # overlapping comms: distinct ctx
+            base = (r // 2) * 2
+            assert qmembers == (base, base + 1)
+            assert total == float(base + base + 1)
+
+    def test_sequential_splits_get_fresh_contexts(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            a = w.split(color=0)
+            b = w.split(color=0)
+            res = (a.context, b.context)
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main, n=4)
+        for actx, bctx in out:
+            assert bctx > actx
+
+    def test_dup_same_members_fresh_ctx(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            sub = w.split(color=0, key=w.rank())
+            d = sub.dup()
+            res = (sub.rank() == d.rank(), sub.members == d.members,
+                   sub.context != d.context)
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main, n=4)
+        assert all(all(o) for o in out)
+
+
+class TestTagIsolation:
+    def test_same_tag_world_and_group(self):
+        """The same user tag live simultaneously on world and on a
+        sub-communicator between the same physical pair must not cross."""
+        TAG = 5
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            sub = w.split(color=0)  # same membership, new ctx
+            got_w = got_g = None
+            if r == 0:
+                # Post both receives first (distinct tag spaces ⇒ the
+                # rendezvous cannot mix them up even though peer+tag match)
+                rw = mpi_tpu.irecv(source=1, tag=TAG)
+                rg = api.Request(lambda: sub.receive(1, TAG))
+                got_w, got_g = rw.wait(30), rg.wait(30)
+            elif r == 1:
+                sub.send(b"group", 0, TAG)
+                mpi_tpu.send(b"world", 0, TAG)
+            mpi_tpu.finalize()
+            return got_w, got_g
+
+        out = spmd(main, n=2)
+        assert out[0] == (b"world", b"group")
+
+    def test_sibling_comms_do_not_cross(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            a = w.split(color=0)
+            b = w.split(color=0)
+            got = None
+            if r == 0:
+                ra = api.Request(lambda: a.receive(1, 3))
+                rb = api.Request(lambda: b.receive(1, 3))
+                got = (ra.wait(30), rb.wait(30))
+            elif r == 1:
+                b.send(b"from-b", 0, 3)
+                a.send(b"from-a", 0, 3)
+            mpi_tpu.finalize()
+            return got
+
+        out = spmd(main, n=2)
+        assert out[0] == (b"from-a", b"from-b")
+
+    def test_negative_world_tag_rejected(self):
+        """A negative world tag could forge a communicator context-region
+        tag; the facade and the ctx-0 comm both refuse it."""
+        def main():
+            mpi_tpu.init()
+            try:
+                with pytest.raises(mpi_tpu.MpiError, match="negative"):
+                    mpi_tpu.send(b"x", 0, -5)
+                with pytest.raises(mpi_tpu.MpiError, match="negative"):
+                    mpi_tpu.receive(0, -5)
+                with pytest.raises(mpi_tpu.MpiError, match="negative"):
+                    comm_world().send(b"x", 0, -5)
+            finally:
+                mpi_tpu.finalize()
+
+        spmd(main, n=2)
+
+    def test_fresh_comm_instances_share_tag_sequence(self):
+        """Reconstructing a communicator (a second comm_world() /
+        identical split) must not reset the collective tag sequence —
+        ranks that cache the Comm and ranks that re-create it per call
+        have to allocate identical tag blocks."""
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            if r == 0:
+                # cached instance: seq advances 0, 1 on one object
+                w = comm_world()
+                a = float(w.allreduce(np.float64(1.0)))
+                b = float(w.allreduce(np.float64(2.0)))
+            else:
+                # fresh instance per call: must continue, not restart
+                a = float(comm_world().allreduce(np.float64(1.0)))
+                b = float(comm_world().allreduce(np.float64(2.0)))
+            mpi_tpu.finalize()
+            return a, b
+
+        out = spmd(main, n=2)
+        assert all(o == (2.0, 4.0) for o in out)
+
+    def test_group_isend_irecv(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            sub = w.split(color=0)
+            g = sub.rank()
+            got = None
+            if g == 0:
+                req = sub.irecv(source=1, tag=4)
+                got = req.wait(30)
+            elif g == 1:
+                sub.isend(b"async-group", 0, 4).wait(30)
+            mpi_tpu.finalize()
+            return got
+
+        out = spmd(main, n=2)
+        assert out[0] == b"async-group"
+
+    def test_group_tag_range_enforced(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            sub = w.split(color=0)
+            try:
+                with pytest.raises(mpi_tpu.MpiError, match="out of range"):
+                    sub.send(b"x", 0, USER_TAG_SPAN)  # too large
+                with pytest.raises(mpi_tpu.MpiError, match="out of range"):
+                    sub.send(b"x", 0, -1)
+            finally:
+                mpi_tpu.finalize()
+
+        spmd(main, n=2)
+
+
+class TestGroupOps:
+    def test_full_collective_suite_in_group(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            sub = w.split(color=r % 2)
+            g, n = sub.rank(), sub.size()
+            x = np.arange(4, dtype=np.float64) + g
+            res = {
+                "allreduce": sub.allreduce(x).tolist(),
+                "bcast": sub.bcast(f"root-{r}" if g == 0 else None),
+                "gathered": sub.gather(g, root=0),
+                "allgather": sub.allgather(g),
+                "scattered": sub.scatter(
+                    [f"p{i}" for i in range(n)] if g == 0 else None),
+                "scan": float(sub.scan(np.float64(g + 1))),
+                "alltoall": sub.alltoall([g * 10 + j for j in range(n)]),
+            }
+            sub.barrier()
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main)
+        for r, res in enumerate(out):
+            members = tuple(range(r % 2, N, 2))
+            n = len(members)
+            g = members.index(r)
+            expect = (np.arange(4, dtype=np.float64) * n
+                      + sum(range(n))).tolist()
+            assert res["allreduce"] == expect
+            assert res["bcast"] == f"root-{members[0]}"
+            assert res["allgather"] == list(range(n))
+            assert res["gathered"] == (list(range(n)) if g == 0 else None)
+            assert res["scattered"] == f"p{g}"
+            assert res["scan"] == float(sum(range(1, g + 2)))
+            assert res["alltoall"] == [j * 10 + g for j in range(n)]
+
+    def test_group_sendrecv_ring(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            sub = w.split(color=w.rank() % 2)
+            g, n = sub.rank(), sub.size()
+            got = sub.sendrecv(("tok", g), dest=(g + 1) % n,
+                               source=(g - 1) % n, tag=2)
+            mpi_tpu.finalize()
+            return got
+
+        out = spmd(main)
+        for r, got in enumerate(out):
+            members = tuple(range(r % 2, N, 2))
+            g = members.index(r)
+            assert got == ("tok", (g - 1) % len(members)) or \
+                got == ["tok", (g - 1) % len(members)]
+
+
+class TestTcpDriver:
+    def test_split_and_group_traffic_over_tcp(self):
+        with tcp_cluster(4) as nets:
+            def body(net, r):
+                w = comm_world(net)
+                sub = w.split(color=r % 2)
+                total = sub.allreduce(np.float64(r))
+                peer = 1 - sub.rank()
+                got = sub.sendrecv(f"hi-{r}", dest=peer, source=peer, tag=1)
+                return float(total), got, sub.members
+
+            out = run_on_ranks(nets, body)
+        assert out[0][0] == 2.0 and out[1][0] == 4.0
+        assert out[0][2] == (0, 2) and out[1][2] == (1, 3)
+        assert out[0][1] == "hi-2" and out[2][1] == "hi-0"
+        assert out[1][1] == "hi-3" and out[3][1] == "hi-1"
+
+    def test_fresh_instances_lockstep_over_tcp(self):
+        """Over the TCP driver (no native collectives — generic
+        algorithms with real wire tags) a rank re-creating comm_world()
+        per call must allocate the same tag blocks as a rank that cached
+        it; a per-instance sequence would desync and hang."""
+        with tcp_cluster(2) as nets:
+            def body(net, r):
+                if r == 0:
+                    w = comm_world(net)
+                    return (float(w.allreduce(np.float64(1.0))),
+                            float(w.allreduce(np.float64(2.0))))
+                return (float(comm_world(net).allreduce(np.float64(1.0))),
+                        float(comm_world(net).allreduce(np.float64(2.0))))
+
+            out = run_on_ranks(nets, body, timeout=20.0)
+        assert out == [(2.0, 4.0), (2.0, 4.0)]
+
+    def test_tag_mapping_fits_wire_i64(self):
+        # Highest-magnitude mapped tag must fit the frame's i64.
+        c = Comm.__new__(Comm)
+        c._impl = None
+        c._members = (0, 1)
+        c._ctx = (1 << 18)  # absurdly many communicators
+        c._world_to_group = {0: 0, 1: 1}
+        t = c._map_tag(USER_TAG_SPAN - 1)
+        assert -(1 << 63) <= t < 0
