@@ -360,7 +360,7 @@ def run_spmd_hybrid(fn: Callable[[], Any], net: HybridNetwork,
 
     def abort() -> None:
         net._inner._init_barrier.abort()
-        net._inner._coll._barrier.abort()
+        net._inner.abort_collectives()
         net._init_done.set()
 
     def on_failure() -> None:

@@ -144,183 +144,44 @@ class _CollectiveSession:
         return self._results[rank]
 
 
-class XlaNetwork:
-    """Backend implementing the :class:`mpi_tpu.api.Interface` SPI over a
-    device mesh. Construct with the rank count (defaults to every visible
-    device) and hand user code to :func:`run_spmd`."""
 
-    def __init__(self, n: Optional[int] = None,
-                 devices: Optional[Sequence[Any]] = None,
-                 deterministic_collectives: bool = False,
-                 oversubscribe: bool = False):
-        jax = _jax()
-        from ..parallel.mesh import make_mesh
+class _MeshCollectives:
+    """Compiled-collective engine over an ordered device list.
 
-        if devices is None:
-            devices = jax.devices()[: n] if n is not None else jax.devices()
-        if n is not None and len(devices) < n:
-            if oversubscribe and devices:
-                # Reference parity: N ranks on fewer cores is always legal
-                # (gompirun spawns N processes regardless of CPU count) —
-                # map ranks onto devices round-robin.
-                base = list(devices)
-                devices = [base[r % len(base)] for r in range(n)]
-            else:
-                raise MpiError(
-                    f"mpi_tpu: need {n} devices for {n} ranks, have "
-                    f"{len(devices)} (pass oversubscribe=True to share)")
+    All of the xla driver's native collectives live here so one
+    machinery serves both the world (one engine per driver) and any
+    communicator group (one engine per ``(context, members)``, built by
+    :meth:`XlaNetwork.group_collectives`): a leader thread runs ONE
+    compiled XLA program over the engine's (sub-)mesh — psum/all_gather/
+    ppermute over ICI on TPU — with host-tree fallbacks when ranks share
+    devices (oversubscription) and object-payload fallbacks preserving
+    the generic driver's semantics. ``rank_of`` maps the calling thread
+    to its rank WITHIN this engine (world rank for the world engine,
+    group rank for a communicator's)."""
+
+    def __init__(self, net: "XlaNetwork", devices: List[Any], mesh,
+                 rank_of: Callable[[], int]):
+        self._net = net
         self._devices = list(devices)
         self._n = len(self._devices)
-        # With oversubscribed (duplicate) devices there is no valid mesh;
-        # native collectives then run on the canonical numpy tree instead
-        # of a compiled XLA collective.
-        if len(set(self._devices)) == len(self._devices):
-            self._mesh = make_mesh(devices=self._devices)
-        else:
-            self._mesh = None
-        self._tls = threading.local()
-        self._init_barrier = threading.Barrier(self._n)
+        self._mesh = mesh
+        self._rank_of = rank_of
         self._coll = _CollectiveSession(self._n)
-        # One rendezvous per ordered (src, dst) pair, created lazily.
-        self._pairs: Dict[Tuple[int, int], Rendezvous] = {}
-        self._pairs_lock = threading.Lock()
         self._jit_cache: Dict[Tuple, Any] = {}
         self._fillers: "OrderedDict[Tuple, Any]" = OrderedDict()
-        self._pipe = None  # lazy DevicePipe (compiled p2p transfers)
-        self._initialized = False
-        self.deterministic_collectives = deterministic_collectives
-
-    # -- rank binding --------------------------------------------------------
-
-    def bind_rank(self, rank: int) -> None:
-        """Associate the calling thread with ``rank`` (run_spmd does this)."""
-        if not 0 <= rank < self._n:
-            raise MpiError(f"mpi_tpu: rank {rank} out of range [0, {self._n})")
-        self._tls.rank = rank
 
     def _myrank(self) -> int:
-        r = getattr(self._tls, "rank", None)
-        if r is None:
-            if self._n == 1:
-                return 0
-            raise MpiError(
-                "mpi_tpu: calling thread has no rank binding — run your "
-                "program under mpi_tpu.backends.xla.run_spmd(fn, n)")
-        return r
-
-    def device(self, rank: Optional[int] = None):
-        """The jax device backing ``rank`` (default: calling thread's)."""
-        return self._devices[self._myrank() if rank is None else rank]
+        return self._rank_of()
 
     @property
-    def mesh(self):
-        return self._mesh
-
-    # -- Interface ------------------------------------------------------------
-
-    def init(self) -> None:
-        """Barrier across all rank threads (the bootstrap analogue —
-        network.go:122-159 collapses to a thread barrier because XLA
-        already knows the topology)."""
-        self._myrank()  # validates binding
-        if self._n > 1:
-            try:
-                self._init_barrier.wait(timeout=60.0)
-            except threading.BrokenBarrierError as exc:
-                raise MpiError(
-                    "mpi_tpu: init barrier broken (a rank failed to start)"
-                ) from exc
-        self._initialized = True
-
-    def finalize(self) -> None:
-        self._initialized = False
-
-    def rank(self) -> int:
-        return self._myrank()
-
-    def size(self) -> int:
-        return self._n
-
-    # -- point-to-point -------------------------------------------------------
-
-    def _pair(self, src: int, dst: int) -> Rendezvous:
-        key = (src, dst)
-        with self._pairs_lock:
-            rv = self._pairs.get(key)
-            if rv is None:
-                rv = Rendezvous(send_peer=dst, recv_peer=src)
-                self._pairs[key] = rv
-            return rv
-
-    def send(self, data: Any, dest: int, tag: int) -> None:
-        """Blocking rendezvous send. Array payloads move to the
-        destination rank's device through a **compiled ppermute program**
-        (:class:`mpi_tpu.parallel.p2p.DevicePipe`) — a pure ICI hop on
-        TPU with no host round-trip of the payload, the tpu-native data
-        path replacing the reference's socket write (network.go:562-567).
-        Host objects are copied, preserving the reference's value
-        semantics (gob round-trip implies the receiver never aliases
-        sender memory)."""
-        me = self._myrank()
-        self._check_rank(dest)
-        jax = _jax()
-        if isinstance(data, jax.Array):
-            payload = self._device_transfer(data, dest)
-        elif isinstance(data, np.ndarray):
-            payload = data.copy()
-        elif isinstance(data, (bytes, str, int, float, bool, complex,
-                               type(None))):
-            payload = data  # immutable
-        else:
-            payload = copy.deepcopy(data)
-        self._pair(me, dest).send(tag, payload)
-
-    def _device_transfer(self, data, dest: int):
-        """Compiled device→device move of a jax.Array to ``dest``'s device.
-
-        Single-device source arrays ride the DevicePipe's cached ppermute
-        executable (ICI); already-in-place, sharded, or uncommitted
-        arrays — and oversubscribed/meshless configurations — fall back
-        to ``jax.device_put`` (which is a no-op when already resident)."""
-        jax = _jax()
-        dst_dev = self._devices[dest]
-        src_devs = getattr(data, "devices", lambda: set())()
-        if (self._mesh is not None and len(src_devs) == 1
-                and getattr(data, "committed", True)):
-            src_dev = next(iter(src_devs))
-            if src_dev != dst_dev:
-                with self._pairs_lock:
-                    if self._pipe is None:
-                        from ..parallel.p2p import DevicePipe
-
-                        self._pipe = DevicePipe()
-                    pipe = self._pipe
-                return pipe.transfer(data, src_dev, dst_dev)
-        return jax.device_put(data, dst_dev)
-
-    def receive(self, source: int, tag: int, out: Optional[Any] = None) -> Any:
-        me = self._myrank()
-        self._check_rank(source)
-        payload = self._pair(source, me).receive(tag)
-        if out is not None and isinstance(out, np.ndarray) \
-                and isinstance(payload, np.ndarray) \
-                and out.shape == payload.shape and out.dtype == payload.dtype:
-            out[...] = payload
-            return out
-        return payload
-
-    def cancel_receive(self, source: int, tag: int) -> bool:
-        me = self._myrank()
-        self._check_rank(source)
-        exc = ReceiveCancelled(
-            f"mpi_tpu: receive(source={source}, tag={tag}) cancelled")
-        return self._pair(source, me).cancel(tag, exc)
+    def deterministic_collectives(self) -> bool:
+        return self._net.deterministic_collectives
 
     def _check_rank(self, r: int) -> None:
         if not 0 <= r < self._n:
-            raise MpiError(f"mpi_tpu: peer rank {r} out of range [0, {self._n})")
+            raise MpiError(
+                f"mpi_tpu: rank {r} out of range [0, {self._n})")
 
-    # -- native collectives ---------------------------------------------------
 
     @staticmethod
     def _validate_payloads(slots: List[np.ndarray]) -> None:
@@ -693,6 +554,279 @@ class XlaNetwork:
         return self._coll.run(self._myrank(), data, leader)
 
 
+class XlaNetwork:
+    """Backend implementing the :class:`mpi_tpu.api.Interface` SPI over a
+    device mesh. Construct with the rank count (defaults to every visible
+    device) and hand user code to :func:`run_spmd`."""
+
+    def __init__(self, n: Optional[int] = None,
+                 devices: Optional[Sequence[Any]] = None,
+                 deterministic_collectives: bool = False,
+                 oversubscribe: bool = False):
+        jax = _jax()
+        from ..parallel.mesh import make_mesh
+
+        if devices is None:
+            devices = jax.devices()[: n] if n is not None else jax.devices()
+        if n is not None and len(devices) < n:
+            if oversubscribe and devices:
+                # Reference parity: N ranks on fewer cores is always legal
+                # (gompirun spawns N processes regardless of CPU count) —
+                # map ranks onto devices round-robin.
+                base = list(devices)
+                devices = [base[r % len(base)] for r in range(n)]
+            else:
+                raise MpiError(
+                    f"mpi_tpu: need {n} devices for {n} ranks, have "
+                    f"{len(devices)} (pass oversubscribe=True to share)")
+        self._devices = list(devices)
+        self._n = len(self._devices)
+        # With oversubscribed (duplicate) devices there is no valid mesh;
+        # native collectives then run on the canonical numpy tree instead
+        # of a compiled XLA collective.
+        if len(set(self._devices)) == len(self._devices):
+            self._mesh = make_mesh(devices=self._devices)
+        else:
+            self._mesh = None
+        self._tls = threading.local()
+        self._init_barrier = threading.Barrier(self._n)
+        # One rendezvous per ordered (src, dst) pair, created lazily.
+        self._pairs: Dict[Tuple[int, int], Rendezvous] = {}
+        self._pairs_lock = threading.Lock()
+        self._pipe = None  # lazy DevicePipe (compiled p2p transfers)
+        self._initialized = False
+        self.deterministic_collectives = deterministic_collectives
+        # Native collectives: one world engine + lazily-built engines per
+        # communicator group (group_collectives), all sharing this
+        # driver's devices and rank binding.
+        self._world_coll = _MeshCollectives(self, self._devices, self._mesh,
+                                            self._myrank)
+        self._group_colls: "OrderedDict[Tuple, _MeshCollectives]" = \
+            OrderedDict()
+
+    # -- rank binding --------------------------------------------------------
+
+    def bind_rank(self, rank: int) -> None:
+        """Associate the calling thread with ``rank`` (run_spmd does this)."""
+        if not 0 <= rank < self._n:
+            raise MpiError(f"mpi_tpu: rank {rank} out of range [0, {self._n})")
+        self._tls.rank = rank
+
+    def _myrank(self) -> int:
+        r = getattr(self._tls, "rank", None)
+        if r is None:
+            if self._n == 1:
+                return 0
+            raise MpiError(
+                "mpi_tpu: calling thread has no rank binding — run your "
+                "program under mpi_tpu.backends.xla.run_spmd(fn, n)")
+        return r
+
+    def device(self, rank: Optional[int] = None):
+        """The jax device backing ``rank`` (default: calling thread's)."""
+        return self._devices[self._myrank() if rank is None else rank]
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    # -- Interface ------------------------------------------------------------
+
+    def init(self) -> None:
+        """Barrier across all rank threads (the bootstrap analogue —
+        network.go:122-159 collapses to a thread barrier because XLA
+        already knows the topology)."""
+        self._myrank()  # validates binding
+        if self._n > 1:
+            try:
+                self._init_barrier.wait(timeout=60.0)
+            except threading.BrokenBarrierError as exc:
+                raise MpiError(
+                    "mpi_tpu: init barrier broken (a rank failed to start)"
+                ) from exc
+        self._initialized = True
+
+    def finalize(self) -> None:
+        self._initialized = False
+
+    def rank(self) -> int:
+        return self._myrank()
+
+    def size(self) -> int:
+        return self._n
+
+    # -- point-to-point -------------------------------------------------------
+
+    def _pair(self, src: int, dst: int) -> Rendezvous:
+        key = (src, dst)
+        with self._pairs_lock:
+            rv = self._pairs.get(key)
+            if rv is None:
+                rv = Rendezvous(send_peer=dst, recv_peer=src)
+                self._pairs[key] = rv
+            return rv
+
+    def send(self, data: Any, dest: int, tag: int) -> None:
+        """Blocking rendezvous send. Array payloads move to the
+        destination rank's device through a **compiled ppermute program**
+        (:class:`mpi_tpu.parallel.p2p.DevicePipe`) — a pure ICI hop on
+        TPU with no host round-trip of the payload, the tpu-native data
+        path replacing the reference's socket write (network.go:562-567).
+        Host objects are copied, preserving the reference's value
+        semantics (gob round-trip implies the receiver never aliases
+        sender memory)."""
+        me = self._myrank()
+        self._check_rank(dest)
+        jax = _jax()
+        if isinstance(data, jax.Array):
+            payload = self._device_transfer(data, dest)
+        elif isinstance(data, np.ndarray):
+            payload = data.copy()
+        elif isinstance(data, (bytes, str, int, float, bool, complex,
+                               type(None))):
+            payload = data  # immutable
+        else:
+            payload = copy.deepcopy(data)
+        self._pair(me, dest).send(tag, payload)
+
+    def _device_transfer(self, data, dest: int):
+        """Compiled device→device move of a jax.Array to ``dest``'s device.
+
+        Single-device source arrays ride the DevicePipe's cached ppermute
+        executable (ICI); already-in-place, sharded, or uncommitted
+        arrays — and oversubscribed/meshless configurations — fall back
+        to ``jax.device_put`` (which is a no-op when already resident)."""
+        jax = _jax()
+        dst_dev = self._devices[dest]
+        src_devs = getattr(data, "devices", lambda: set())()
+        if (self._mesh is not None and len(src_devs) == 1
+                and getattr(data, "committed", True)):
+            src_dev = next(iter(src_devs))
+            if src_dev != dst_dev:
+                with self._pairs_lock:
+                    if self._pipe is None:
+                        from ..parallel.p2p import DevicePipe
+
+                        self._pipe = DevicePipe()
+                    pipe = self._pipe
+                return pipe.transfer(data, src_dev, dst_dev)
+        return jax.device_put(data, dst_dev)
+
+    def receive(self, source: int, tag: int, out: Optional[Any] = None) -> Any:
+        me = self._myrank()
+        self._check_rank(source)
+        payload = self._pair(source, me).receive(tag)
+        if out is not None and isinstance(out, np.ndarray) \
+                and isinstance(payload, np.ndarray) \
+                and out.shape == payload.shape and out.dtype == payload.dtype:
+            out[...] = payload
+            return out
+        return payload
+
+    def cancel_receive(self, source: int, tag: int) -> bool:
+        me = self._myrank()
+        self._check_rank(source)
+        exc = ReceiveCancelled(
+            f"mpi_tpu: receive(source={source}, tag={tag}) cancelled")
+        return self._pair(source, me).cancel(tag, exc)
+
+    def _check_rank(self, r: int) -> None:
+        if not 0 <= r < self._n:
+            raise MpiError(f"mpi_tpu: peer rank {r} out of range [0, {self._n})")
+
+    # -- native collectives (world engine; see _MeshCollectives) -------------
+
+    def allreduce(self, data: Any, op: str = "sum",
+                  deterministic: Optional[bool] = None) -> Any:
+        return self._world_coll.allreduce(data, op=op,
+                                          deterministic=deterministic)
+
+    def barrier(self) -> None:
+        return self._world_coll.barrier()
+
+    def bcast(self, data: Any, root: int = 0) -> Any:
+        return self._world_coll.bcast(data, root=root)
+
+    def gather(self, data: Any, root: int = 0) -> Optional[List[Any]]:
+        return self._world_coll.gather(data, root=root)
+
+    def allgather(self, data: Any) -> List[Any]:
+        return self._world_coll.allgather(data)
+
+    def scatter(self, data: Optional[List[Any]], root: int = 0) -> Any:
+        return self._world_coll.scatter(data, root=root)
+
+    def alltoall(self, data: List[Any]) -> List[Any]:
+        return self._world_coll.alltoall(data)
+
+    def reduce(self, data: Any, root: int = 0,
+               op: str = "sum") -> Optional[Any]:
+        return self._world_coll.reduce(data, root=root, op=op)
+
+    def reduce_scatter(self, data: Any, op: str = "sum",
+                       deterministic: Optional[bool] = None) -> Any:
+        return self._world_coll.reduce_scatter(data, op=op,
+                                               deterministic=deterministic)
+
+    # -- communicator group engines ------------------------------------------
+
+    def group_collectives(self, members, ctx: int) -> _MeshCollectives:
+        """Compiled-collective engine for a communicator group: the
+        members' devices become a sub-mesh and every collective in the
+        suite runs as one compiled XLA program over it (host/object
+        fallbacks included), exactly like the world path. One shared
+        engine per ``(ctx, members)`` — all member rank threads must use
+        the same instance, since it holds their rendezvous barrier."""
+        key = (int(ctx), tuple(int(m) for m in members))
+        with self._pairs_lock:
+            eng = self._group_colls.get(key)
+            if eng is not None:
+                self._group_colls.move_to_end(key)
+                return eng
+            from ..parallel.mesh import make_mesh
+
+            for m in key[1]:
+                self._check_rank(m)
+            devs = [self._devices[m] for m in key[1]]
+            mesh = (make_mesh(devices=devs)
+                    if len(set(devs)) == len(devs) else None)
+            members_t = key[1]
+            eng = _MeshCollectives(
+                self, devs, mesh,
+                lambda mt=members_t: mt.index(self._myrank()))
+            self._group_colls[key] = eng
+            # LRU backstop for leaked communicators (dup-per-call
+            # patterns): each engine pins compiled executables and filler
+            # device buffers. Comm.free() is the precise release; the cap
+            # only evicts least-recently-used engines, which are safe to
+            # drop unless more than _GROUP_ENGINE_CACHE communicators are
+            # *concurrently* mid-collective (an evicted-but-live group
+            # would re-create its engine and lose barrier pairing).
+            while len(self._group_colls) > self._GROUP_ENGINE_CACHE:
+                self._group_colls.popitem(last=False)
+        return eng
+
+    _GROUP_ENGINE_CACHE = 128
+
+    def release_group_collectives(self, members, ctx: int) -> None:
+        """Drop the group engine for ``(ctx, members)`` (Comm.free):
+        frees its compiled programs and filler buffers. Idempotent; must
+        not race a collective in flight on that communicator."""
+        key = (int(ctx), tuple(int(m) for m in members))
+        with self._pairs_lock:
+            self._group_colls.pop(key, None)
+
+    def abort_collectives(self) -> None:
+        """Break every collective barrier (world + group engines) so rank
+        threads blocked in a collective fail fast when a sibling dies."""
+        self._world_coll._coll._barrier.abort()
+        with self._pairs_lock:
+            engines = list(self._group_colls.values())
+        for e in engines:
+            e._coll._barrier.abort()
+
+
+
 def drive_rank_threads(fn: Callable[[], Any], *, nranks: int,
                        bind: Callable[[int], None],
                        abort: Callable[[], None],
@@ -784,7 +918,7 @@ def run_spmd(fn: Callable[[], Any], n: Optional[int] = None,
 
     def abort() -> None:
         network._init_barrier.abort()
-        network._coll._barrier.abort()
+        network.abort_collectives()
 
     return drive_rank_threads(
         fn, nranks=network.size(), bind=network.bind_rank, abort=abort,

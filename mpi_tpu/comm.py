@@ -279,6 +279,17 @@ class Comm:
             if native is not None:
                 return native(*args, **kwargs)
             return getattr(gen, name)(self._impl, *args, **kwargs)
+        # Drivers that expose compiled group engines (the xla driver's
+        # sub-mesh _MeshCollectives) serve the whole suite as single
+        # compiled XLA programs over the group's devices; ops an engine
+        # lacks (scan/exscan) and engineless drivers use the generic
+        # algorithms over this Comm's translated send/receive.
+        group_engine = getattr(self._impl, "group_collectives", None)
+        if group_engine is not None:
+            native = getattr(group_engine(self._members, self._ctx),
+                             name, None)
+            if native is not None:
+                return native(*args, **kwargs)
         return getattr(gen, name)(self, *args, **kwargs)
 
     def allreduce(self, data: Any, op: str = "sum") -> Any:
@@ -349,6 +360,19 @@ class Comm:
         child = self.split(color=0, key=self.rank())
         assert child is not None
         return child
+
+    def free(self) -> None:
+        """Release driver resources held for this communicator —
+        compiled group-collective programs and their device buffers on
+        the xla driver (MPI_Comm_free). Call it from every member once
+        no operation is in flight; the Comm must not be used afterwards
+        (a stray call would silently rebuild the engine). No-op on
+        drivers without per-group state and on the world communicator."""
+        if self._ctx == 0:
+            return
+        release = getattr(self._impl, "release_group_collectives", None)
+        if release is not None:
+            release(self._members, self._ctx)
 
 
 def comm_world(impl: Optional[Interface] = None) -> Comm:

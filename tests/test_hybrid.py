@@ -195,6 +195,26 @@ def test_barrier_and_reduce():
 
 
 @pytest.mark.integration
+def test_rank_failure_aborts_collective_not_hangs():
+    """A rank that dies while siblings sit in a native collective must
+    break their barrier (the abort path through
+    XlaNetwork.abort_collectives), not leave them hanging."""
+    def fn_for(net):
+        def main():
+            net.init()
+            r = net.rank()
+            if r == 1:
+                raise RuntimeError("boom on rank 1")
+            net.allreduce(np.float32([1.0]))
+            net.finalize()
+        return main
+
+    from mpi_tpu.api import MpiError
+
+    with pytest.raises((RuntimeError, MpiError)):
+        run_world(fn_for, timeout=30.0)
+
+
 def test_hybrid_end_to_end_via_mpirun(tmp_path):
     """2 OS processes (hosts) x 2 local ranks = 4 global ranks, launched
     with the reference flag ABI plus --mpi-backend hybrid."""

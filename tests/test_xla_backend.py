@@ -516,7 +516,7 @@ class TestCompiledCollectivePaths:
         out, net = self._run(main)
         for o in out:
             np.testing.assert_array_equal(o, data + 1)
-        assert ("bcast", "", False, 2) in net._jit_cache
+        assert ("bcast", "", False, 2) in net._world_coll._jit_cache
 
     def test_scatter_array_compiled(self):
         def main():
@@ -547,7 +547,7 @@ class TestCompiledCollectivePaths:
         for i, row in enumerate(out[3]):
             np.testing.assert_array_equal(row, np.full((2, 2), float(i)))
         assert all(out[i] is None for i in range(N) if i != 3)
-        assert ("allgather", "", False) in net._jit_cache
+        assert ("allgather", "", False) in net._world_coll._jit_cache
 
     def test_alltoall_array_compiled(self):
         def main():
@@ -562,7 +562,7 @@ class TestCompiledCollectivePaths:
         out, net = self._run(main)
         for dst in range(N):
             assert out[dst] == [src * 10 + dst for src in range(N)]
-        assert ("alltoall", "", False) in net._jit_cache
+        assert ("alltoall", "", False) in net._world_coll._jit_cache
 
     def test_alltoall_object_fallback(self):
         def main():
@@ -594,7 +594,7 @@ class TestCompiledCollectivePaths:
             assert o.shape == (2,)
             np.testing.assert_allclose(o, total[i * 2:(i + 1) * 2],
                                        rtol=1e-5)
-        assert ("reduce_scatter", "sum", True) in net._jit_cache
+        assert ("reduce_scatter", "sum", True) in net._world_coll._jit_cache
 
     def test_reduce_scatter_bitwise_vs_tcp(self):
         """Deterministic XLA reduce_scatter == generic tree order over the
@@ -656,9 +656,9 @@ class TestCompiledCollectivePaths:
             for r in range(N):
                 np.testing.assert_array_equal(rows_i[r], i64 + r)
                 np.testing.assert_allclose(rows_f[r], f64 + r)
-        assert ("bcast", "", False, 0) in net._jit_cache
-        assert ("bcast", "", False, 1) in net._jit_cache
-        assert ("allgather", "", False) in net._jit_cache
+        assert ("bcast", "", False, 0) in net._world_coll._jit_cache
+        assert ("bcast", "", False, 1) in net._world_coll._jit_cache
+        assert ("allgather", "", False) in net._world_coll._jit_cache
 
 
 class TestNonblocking:
