@@ -62,8 +62,8 @@ def main() -> None:
 
     from mpi_tpu.data import ShardedLoader, SyntheticLM
     from mpi_tpu.models import TransformerConfig, make_mesh_nd, make_train_step
-    from mpi_tpu.utils import (latest_step, restore_checkpoint,
-                               save_checkpoint, trace)
+    from mpi_tpu.utils import (AsyncCheckpointer, latest_step,
+                               restore_checkpoint, trace)
 
     if args.trace:
         trace.enable()
@@ -100,6 +100,7 @@ def main() -> None:
     loader = iter(ShardedLoader(
         SyntheticLM(cfg.vocab, args.batch, args.seq), mesh=mesh,
         start_step=start))
+    ckpt = AsyncCheckpointer()
     for i in range(start, start + args.steps):
         tokens = next(loader)
         with trace.span("train.step", step=i):
@@ -109,9 +110,12 @@ def main() -> None:
             dt = time.perf_counter() - t0
         print(f"step {i:4d}  loss {loss:.4f}  {dt * 1e3:7.1f} ms")
         if (i + 1) % args.checkpoint_every == 0:
-            save_checkpoint(args.checkpoint_dir, state, step=i + 1,
-                            max_to_keep=3)
-            print(f"checkpointed step {i + 1}")
+            # Async: the step loop only pays for the HBM->host snapshot;
+            # npz encode + rename land on the writer thread.
+            ckpt.save(args.checkpoint_dir, state, step=i + 1,
+                      max_to_keep=3)
+            print(f"checkpointing step {i + 1} (async)")
+    ckpt.wait()
 
     if args.sample:
         import numpy as np

@@ -3,6 +3,7 @@
 from .serialize import CodecError, Raw, decode, encode
 from . import trace
 from .checkpoint import (
+    AsyncCheckpointer,
     all_steps,
     latest_step,
     restore_checkpoint,
@@ -12,4 +13,5 @@ from .checkpoint import (
 __all__ = [
     "CodecError", "Raw", "decode", "encode", "trace",
     "save_checkpoint", "restore_checkpoint", "latest_step", "all_steps",
+    "AsyncCheckpointer",
 ]

@@ -23,9 +23,11 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import re
 import shutil
 import tempfile
+import threading
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -35,6 +37,7 @@ __all__ = [
     "restore_checkpoint",
     "latest_step",
     "all_steps",
+    "AsyncCheckpointer",
 ]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
@@ -49,19 +52,40 @@ def _flatten(state: Any):
     return keys, leaves, treedef
 
 
-def save_checkpoint(directory: str, state: Any, step: int,
-                    max_to_keep: Optional[int] = None) -> str:
-    """Write ``state`` (pytree of arrays/scalars) as ``step_{step}``.
-    Returns the checkpoint path. ``max_to_keep`` prunes oldest steps."""
+def _snapshot(state: Any, copy: bool = False):
+    """Gather ``state`` to host: (keys, {key: ndarray}).
+
+    ``copy=True`` forces owned copies — required when the write happens
+    later (async): ``device_get`` of a numpy leaf returns the caller's
+    own array, and on the CPU backend even a jax.Array can alias the
+    live buffer, so without a copy the training loop's next in-place
+    update (or donation) would tear the checkpoint."""
     import jax
 
-    os.makedirs(directory, exist_ok=True)
     keys, leaves, _ = _flatten(state)
     arrays: Dict[str, np.ndarray] = {}
     for key, leaf in zip(keys, leaves):
         # Sharded device arrays gather to host; everything numeric becomes
         # an ndarray (0-d for scalars) so the npz round-trip is lossless.
-        arrays[key] = np.asarray(jax.device_get(leaf))
+        arr = np.asarray(jax.device_get(leaf))
+        arrays[key] = arr.copy() if copy else arr
+    return keys, arrays
+
+
+def save_checkpoint(directory: str, state: Any, step: int,
+                    max_to_keep: Optional[int] = None) -> str:
+    """Write ``state`` (pytree of arrays/scalars) as ``step_{step}``.
+    Returns the checkpoint path. ``max_to_keep`` prunes oldest steps."""
+    keys, arrays = _snapshot(state)
+    return _write_checkpoint(directory, keys, arrays, step, max_to_keep)
+
+
+def _write_checkpoint(directory: str, keys: List[str],
+                      arrays: Dict[str, np.ndarray], step: int,
+                      max_to_keep: Optional[int] = None) -> str:
+    """Disk half of a save: npz + meta into a temp dir, then the
+    park-and-rename overwrite dance. Host-only (no jax)."""
+    os.makedirs(directory, exist_ok=True)
     final = os.path.join(directory, f"step_{step}")
     tmp = tempfile.mkdtemp(prefix=f".step_{step}.tmp.", dir=directory)
     try:
@@ -106,6 +130,128 @@ def save_checkpoint(directory: str, state: Any, step: int,
             shutil.rmtree(os.path.join(directory, f"step_{old}"),
                           ignore_errors=True)
     return final
+
+
+class _SaveHandle:
+    """Completion handle for one async save (a tiny future)."""
+
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self._path: Optional[str] = None
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        """Block until the write lands; return the checkpoint path or
+        re-raise the write error."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("mpi_tpu: async checkpoint still writing")
+        if self._exc is not None:
+            raise self._exc
+        assert self._path is not None
+        return self._path
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writer: training resumes while bytes hit disk.
+
+    The device→host gather happens **synchronously** on the caller thread
+    (a snapshot — so the train loop may immediately donate/overwrite its
+    buffers), and the disk half (npz encode, fsync-free writes, the
+    park-and-rename overwrite) runs on a single worker thread, which also
+    keeps concurrent saves step-ordered. This is the standard TPU
+    checkpointing shape (compute waits only for HBM→host, not for disk).
+
+    Use as a context manager or call :meth:`wait` /:meth:`close`; both
+    re-raise the first background write error.
+    """
+
+    def __init__(self) -> None:
+        self._jobs: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._first_exc: Optional[BaseException] = None
+        self._closed = False
+
+    def save(self, directory: str, state: Any, step: int,
+             max_to_keep: Optional[int] = None) -> _SaveHandle:
+        """Snapshot ``state`` now; write ``step_{step}`` in the background.
+        Returns a handle whose ``result()`` blocks for this save only."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("mpi_tpu: AsyncCheckpointer is closed")
+            if self._first_exc is not None:
+                exc, self._first_exc = self._first_exc, None
+                raise exc
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._run, name="mpi-ckpt-writer", daemon=True)
+                self._worker.start()
+        keys, arrays = _snapshot(state, copy=True)
+        handle = _SaveHandle()
+        # Enqueue under the lock: the snapshot above can take seconds, and
+        # a concurrent close() must either see this job (queued before the
+        # shutdown sentinel) or make this call raise — never strand the
+        # job on a dead queue with a forever-pending handle.
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(
+                    "mpi_tpu: AsyncCheckpointer closed during save()")
+            self._jobs.put((directory, keys, arrays, step, max_to_keep,
+                            handle))
+        return handle
+
+    def _run(self) -> None:
+        while True:
+            job = self._jobs.get()
+            try:
+                if job is None:
+                    return
+                directory, keys, arrays, step, max_to_keep, handle = job
+                try:
+                    handle._path = _write_checkpoint(
+                        directory, keys, arrays, step, max_to_keep)
+                except BaseException as exc:  # noqa: BLE001 — reported
+                    handle._exc = exc         # via handle and wait()
+                    with self._lock:
+                        if self._first_exc is None:
+                            self._first_exc = exc
+                finally:
+                    handle._done.set()
+            finally:
+                self._jobs.task_done()
+
+    def wait(self) -> None:
+        """Block until every queued save has landed; re-raise the first
+        background error (also surfaced by the failing save's handle)."""
+        self._jobs.join()
+        with self._lock:
+            exc, self._first_exc = self._first_exc, None
+        if exc is not None:
+            raise exc
+
+    def close(self) -> None:
+        """Drain pending saves and stop the worker (idempotent)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            worker = self._worker
+        if worker is not None:
+            self._jobs.put(None)
+            worker.join()
+        with self._lock:
+            exc, self._first_exc = self._first_exc, None
+        if exc is not None:
+            raise exc
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
 
 
 _OLD_RE = re.compile(r"^\.step_(\d+)\.old\.")

@@ -41,3 +41,16 @@ class TestBounce:
         res = _mpirun(1, "examples/bounce.py")
         assert res.returncode != 0
         assert "even number of ranks" in res.stderr + res.stdout
+
+
+@pytest.mark.integration
+class TestCommGroups:
+    def test_2x2_grid(self):
+        res = _mpirun(4, "examples/comm_groups.py")
+        assert res.returncode == 0, res.stderr
+        assert "grid 2x2: per-column sums [2.0, 4.0] (total 6.0)" \
+            in res.stdout
+        # Every rank verifies its own row/col reductions (exit!=0 on
+        # mismatch); spot-check one line of the per-rank report.
+        assert "rank 3 = grid (1, 1)  row_sum=5.0  col_sum=4.0" \
+            in res.stdout

@@ -10,6 +10,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import mpi_tpu
 from mpi_tpu.utils import (
+    AsyncCheckpointer,
     latest_step,
     restore_checkpoint,
     save_checkpoint,
@@ -167,3 +168,57 @@ class TestCheckpoint:
         restored, l1 = step(restored, toks)  # must not raise
         _, l1b = step(state, toks)
         assert float(l1) == pytest.approx(float(l1b))
+
+
+class TestAsyncCheckpointer:
+    def test_async_roundtrip_and_ordering(self, tmp_path):
+        state = {"w": jnp.arange(6.0).reshape(2, 3), "step": 0}
+        with AsyncCheckpointer() as ckpt:
+            handles = []
+            for s in range(3):
+                state = {"w": state["w"] + 1.0, "step": s}
+                handles.append(ckpt.save(str(tmp_path), state, step=s,
+                                         max_to_keep=2))
+            paths = [h.result(30) for h in handles]
+            ckpt.wait()
+        assert [p.endswith(f"step_{s}") for s, p in enumerate(paths)]
+        # max_to_keep=2 pruned step 0 (writes are ordered by the single
+        # worker, so the prune decision saw all three steps).
+        assert latest_step(str(tmp_path)) == 2
+        got = restore_checkpoint(str(tmp_path),
+                                 {"w": jnp.zeros((2, 3)), "step": 9})
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.arange(6.0).reshape(2, 3) + 3.0)
+        assert got["step"] == 2
+
+    def test_snapshot_is_immune_to_buffer_reuse(self, tmp_path):
+        """The device->host gather happens at save() time: mutating the
+        array object's np source afterwards must not leak into the file."""
+        src = np.ones((4,), np.float32)
+        ckpt = AsyncCheckpointer()
+        try:
+            h = ckpt.save(str(tmp_path), {"x": src}, step=1)
+            src[:] = -1.0  # "train step" overwrites the buffer
+            h.result(30)
+        finally:
+            ckpt.close()
+        got = restore_checkpoint(str(tmp_path), {"x": np.zeros(4, np.float32)})
+        np.testing.assert_array_equal(got["x"], np.ones((4,), np.float32))
+
+    def test_write_error_surfaces_on_wait(self, tmp_path):
+        target = tmp_path / "not_a_dir"
+        target.write_text("occupied")  # makedirs will fail on a file
+        ckpt = AsyncCheckpointer()
+        h = ckpt.save(str(target), {"x": np.ones(2)}, step=0)
+        with pytest.raises(OSError):
+            h.result(30)
+        with pytest.raises(OSError):
+            ckpt.wait()
+        ckpt.close()
+
+    def test_closed_checkpointer_rejects_saves(self, tmp_path):
+        ckpt = AsyncCheckpointer()
+        ckpt.save(str(tmp_path), {"x": np.ones(2)}, step=0).result(30)
+        ckpt.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            ckpt.save(str(tmp_path), {"x": np.ones(2)}, step=1)
