@@ -51,6 +51,7 @@ import math
 import os
 import statistics
 import sys
+import threading
 import time
 
 BOUNCE_SIZE = 1_000_000   # bytes — the 1e6 row of the bounce sweep
@@ -561,6 +562,28 @@ def _allreduce_on_virtual_mesh(size_bytes: int) -> dict:
             if k.endswith("_gbps") or k.endswith("_p50_us")}
 
 
+def _install_watchdog(seconds: float) -> threading.Timer:
+    """Guarantee the one-JSON-line stdout contract even if the device
+    hangs: a jax call stuck on an unresponsive TPU/tunnel blocks forever
+    and cannot be interrupted from Python, so after ``seconds`` this
+    prints an error-marked JSON line and hard-exits (``os._exit`` — the
+    stuck runtime threads cannot be joined). Tune/disable with
+    ``MPI_TPU_BENCH_DEADLINE_S`` (0 disables)."""
+    def fire() -> None:
+        print(json.dumps({
+            "metric": "train_step_mfu", "value": 0.0, "unit": "pct",
+            "vs_baseline": 0.0,
+            "error": f"bench watchdog fired after {seconds:.0f}s — "
+                     f"device/tunnel unresponsive",
+        }), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main() -> int:
     if "--_bounce-child" in sys.argv:
         return _bounce_tcp_child()
@@ -589,6 +612,9 @@ def main() -> int:
     # --smoke: tiny shapes so CI can exercise the full harness path on
     # CPU in seconds; the real run uses the defaults on the real chip.
     smoke = "--smoke" in sys.argv
+
+    deadline = float(os.environ.get("MPI_TPU_BENCH_DEADLINE_S", "2400"))
+    watchdog = _install_watchdog(deadline) if deadline > 0 else None
 
     # TCP bounce first: subprocesses, no device contention with the rest.
     tcp_us = bounce_tcp()
@@ -629,6 +655,8 @@ def main() -> int:
     line = {"metric": "train_step_mfu", "value": mfu, "unit": "pct",
             "vs_baseline": round(mfu / MFU_BASELINE_PCT, 3)}
     line.update(result)
+    if watchdog is not None:
+        watchdog.cancel()
     print(json.dumps(line))
     return 0
 

@@ -161,6 +161,11 @@ class HybridNetwork:
     def size(self) -> int:
         return self._size
 
+    def host_key(self) -> int:
+        """Machine identity for ``Comm.split_type("host")``: this host's
+        index in the TCP tier, shared by all its local ranks."""
+        return self._tcp.rank()
+
     # -- point-to-point -------------------------------------------------------
 
     def send(self, data: Any, dest: int, tag: int) -> None:

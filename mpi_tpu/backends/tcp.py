@@ -220,6 +220,19 @@ class TcpNetwork:
             raise MpiError("mpi_tpu: size() before init()")
         return self._size
 
+    def host_key(self) -> str:
+        """Machine identity for ``Comm.split_type("host")``: the host part
+        of this rank's address (textual match — localhost spellings
+        collapse to one key; unix-domain sockets are single-machine)."""
+        if self.addr is None:
+            raise MpiError("mpi_tpu: host_key() before init()")
+        if self.proto == "unix":
+            return "unix"
+        host, _, _ = self.addr.rpartition(":")
+        host = host.lower()
+        return "127.0.0.1" if host in ("", "localhost", "::1", "[::1]") \
+            else host
+
     def init(self) -> None:
         """Resolve config, assign ranks, build the all-to-all mesh
         (network.go:53-65)."""

@@ -655,6 +655,11 @@ class XlaNetwork:
     def size(self) -> int:
         return self._n
 
+    def host_key(self) -> str:
+        """All xla-driver ranks share one process (one host) — a single
+        key, so ``Comm.split_type("host")`` yields the whole world."""
+        return "local"
+
     # -- point-to-point -------------------------------------------------------
 
     def _pair(self, src: int, dst: int) -> Rendezvous:

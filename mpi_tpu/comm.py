@@ -354,6 +354,23 @@ class Comm:
         assert child._world_to_group.get(self._members[me]) is not None
         return child
 
+    def split_type(self, kind: str = "host", key: int = 0
+                   ) -> Optional["Comm"]:
+        """Split into communicators of co-located ranks —
+        MPI_Comm_split_type with MPI_COMM_TYPE_SHARED semantics.
+
+        ``kind="host"`` groups members that share a machine, as reported
+        by the driver's ``host_key()``: the address host for the TCP
+        driver (textual match, localhost forms collapsed), the host index
+        for the hybrid driver, and a single key for the xla driver (all
+        ranks live in one process). Drivers without ``host_key`` are
+        treated as single-host. Collective, like :meth:`split`."""
+        if kind != "host":
+            raise MpiError(
+                f"mpi_tpu: unknown split_type kind {kind!r}; only 'host'")
+        hk = getattr(self._impl, "host_key", None)
+        return self.split(color=hk() if hk is not None else 0, key=key)
+
     def dup(self) -> "Comm":
         """A communicator with identical membership and ordering but a
         fresh context — isolates library traffic (MPI_Comm_dup)."""

@@ -168,10 +168,21 @@ class AsyncCheckpointer:
     re-raise the first background write error.
     """
 
-    def __init__(self) -> None:
-        self._jobs: "queue.Queue[Optional[tuple]]" = queue.Queue()
+    def __init__(self, max_pending: int = 2) -> None:
+        # Bounded: each queued job holds a full host copy of the state,
+        # so when disk is slower than the checkpoint cadence, save()
+        # BLOCKS once ``max_pending`` snapshots are in flight instead of
+        # accumulating model-sized copies until the host OOMs. (This
+        # backpressure is why the worker is hand-rolled rather than a
+        # ThreadPoolExecutor, whose work queue is unbounded.)
+        self._jobs: "queue.Queue[Optional[tuple]]" = queue.Queue(
+            maxsize=max(1, max_pending))
         self._worker: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        # _first_exc has its own lock: save() may block in a full-queue
+        # put() while holding _lock, and the worker's error path must
+        # stay able to record its failure (and task_done) meanwhile.
+        self._exc_lock = threading.Lock()
         self._first_exc: Optional[BaseException] = None
         self._closed = False
 
@@ -179,12 +190,13 @@ class AsyncCheckpointer:
              max_to_keep: Optional[int] = None) -> _SaveHandle:
         """Snapshot ``state`` now; write ``step_{step}`` in the background.
         Returns a handle whose ``result()`` blocks for this save only."""
-        with self._lock:
-            if self._closed:
-                raise RuntimeError("mpi_tpu: AsyncCheckpointer is closed")
+        with self._exc_lock:
             if self._first_exc is not None:
                 exc, self._first_exc = self._first_exc, None
                 raise exc
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("mpi_tpu: AsyncCheckpointer is closed")
             if self._worker is None:
                 self._worker = threading.Thread(
                     target=self._run, name="mpi-ckpt-writer", daemon=True)
@@ -215,7 +227,7 @@ class AsyncCheckpointer:
                         directory, keys, arrays, step, max_to_keep)
                 except BaseException as exc:  # noqa: BLE001 — reported
                     handle._exc = exc         # via handle and wait()
-                    with self._lock:
+                    with self._exc_lock:
                         if self._first_exc is None:
                             self._first_exc = exc
                 finally:
@@ -227,7 +239,7 @@ class AsyncCheckpointer:
         """Block until every queued save has landed; re-raise the first
         background error (also surfaced by the failing save's handle)."""
         self._jobs.join()
-        with self._lock:
+        with self._exc_lock:
             exc, self._first_exc = self._first_exc, None
         if exc is not None:
             raise exc
@@ -242,7 +254,7 @@ class AsyncCheckpointer:
         if worker is not None:
             self._jobs.put(None)
             worker.join()
-        with self._lock:
+        with self._exc_lock:
             exc, self._first_exc = self._first_exc, None
         if exc is not None:
             raise exc

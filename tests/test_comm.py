@@ -159,6 +159,29 @@ class TestSplit:
         for actx, bctx in out:
             assert bctx > actx
 
+    def test_split_type_host_on_xla_is_world(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            node = w.split_type("host")
+            res = (node.members, node.rank() == w.rank())
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main, n=4)
+        assert all(o == ((0, 1, 2, 3), True) for o in out)
+
+    def test_split_type_unknown_kind_rejected(self):
+        def main():
+            mpi_tpu.init()
+            try:
+                with pytest.raises(mpi_tpu.MpiError, match="split_type"):
+                    comm_world().split_type("numa")
+            finally:
+                mpi_tpu.finalize()
+
+        spmd(main, n=2)
+
     def test_dup_same_members_fresh_ctx(self):
         def main():
             mpi_tpu.init()
@@ -475,6 +498,25 @@ class TestTcpDriver:
 
             out = run_on_ranks(nets, body, timeout=20.0)
         assert out == [(2.0, 4.0), (2.0, 4.0)]
+
+    def test_split_type_host_over_tcp_localhost(self):
+        # All tcp_cluster ranks are 127.0.0.1 -> one host group.
+        with tcp_cluster(3) as nets:
+            def body(net, r):
+                node = comm_world(net).split_type("host")
+                return node.members, net.host_key()
+
+            out = run_on_ranks(nets, body)
+        assert all(o == ((0, 1, 2), "127.0.0.1") for o in out)
+
+    def test_host_key_textual_normalization(self):
+        from mpi_tpu.backends.tcp import TcpNetwork
+
+        assert TcpNetwork(addr="LOCALHOST:5000").host_key() == "127.0.0.1"
+        assert TcpNetwork(addr=":5000").host_key() == "127.0.0.1"
+        assert TcpNetwork(addr="nodeA:5000").host_key() == "nodea"
+        assert TcpNetwork(addr="/tmp/s.sock", proto="unix").host_key() \
+            == "unix"
 
     def test_tag_mapping_fits_wire_i64(self):
         # Highest-magnitude mapped tag must fit the frame's i64.

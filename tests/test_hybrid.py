@@ -215,6 +215,27 @@ def test_rank_failure_aborts_collective_not_hangs():
         run_world(fn_for, timeout=30.0)
 
 
+def test_split_type_host_groups_local_ranks():
+    """split_type('host') over the hybrid world yields one communicator
+    per host, containing exactly that host's local ranks."""
+    from mpi_tpu.comm import comm_world
+
+    def fn_for(net):
+        def main():
+            net.init()
+            node = comm_world(net).split_type("host")
+            total = node.allreduce(np.float32(net.rank()))
+            res = (node.members, node.rank(), float(total))
+            net.finalize()
+            return res
+        return main
+
+    out = run_world(fn_for)
+    assert out[0][0] == (0, 1) and out[2][0] == (2, 3)
+    assert [o[1] for o in out] == [0, 1, 0, 1]
+    assert [o[2] for o in out] == [1.0, 1.0, 5.0, 5.0]
+
+
 def test_hybrid_end_to_end_via_mpirun(tmp_path):
     """2 OS processes (hosts) x 2 local ranks = 4 global ranks, launched
     with the reference flag ABI plus --mpi-backend hybrid."""

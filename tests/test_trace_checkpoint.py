@@ -181,7 +181,7 @@ class TestAsyncCheckpointer:
                                          max_to_keep=2))
             paths = [h.result(30) for h in handles]
             ckpt.wait()
-        assert [p.endswith(f"step_{s}") for s, p in enumerate(paths)]
+        assert all(p.endswith(f"step_{s}") for s, p in enumerate(paths))
         # max_to_keep=2 pruned step 0 (writes are ordered by the single
         # worker, so the prune decision saw all three steps).
         assert latest_step(str(tmp_path)) == 2
@@ -204,6 +204,34 @@ class TestAsyncCheckpointer:
             ckpt.close()
         got = restore_checkpoint(str(tmp_path), {"x": np.zeros(4, np.float32)})
         np.testing.assert_array_equal(got["x"], np.ones((4,), np.float32))
+
+    def test_backpressure_bounds_pending_snapshots(self, tmp_path,
+                                                   monkeypatch):
+        """With max_pending=1 a save() must block while a slow write
+        drains, instead of queueing unbounded host copies of the state."""
+        import time
+
+        import mpi_tpu.utils.checkpoint as ck
+
+        orig = ck._write_checkpoint
+
+        def slow(*args, **kwargs):
+            time.sleep(0.25)
+            return orig(*args, **kwargs)
+
+        monkeypatch.setattr(ck, "_write_checkpoint", slow)
+        ckpt = ck.AsyncCheckpointer(max_pending=1)
+        try:
+            t0 = time.monotonic()
+            handles = [ckpt.save(str(tmp_path), {"x": np.ones(2)}, step=s)
+                       for s in range(3)]
+            enqueue_time = time.monotonic() - t0
+            ckpt.wait()
+        finally:
+            ckpt.close()
+        # The third save cannot enqueue until the first write finished.
+        assert enqueue_time >= 0.25
+        assert all(h.done() for h in handles)
 
     def test_write_error_surfaces_on_wait(self, tmp_path):
         target = tmp_path / "not_a_dir"
