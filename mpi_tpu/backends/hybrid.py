@@ -34,6 +34,7 @@ ranks; intra-host tags are unrestricted.
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, List, Optional, Sequence
 
 from .. import collectives_generic as G
@@ -49,6 +50,16 @@ _MAX_GLOBAL = 1 << 15
 
 
 def _compose_tag(src: int, dst: int, tag: int) -> int:
+    if tag < 0:
+        # Sub-communicator tag regions (mpi_tpu.comm) don't fit the
+        # composed cross-host form (ctx + tag + src + dst exceed 64
+        # bits); group COLLECTIVES still work hierarchically via
+        # group_collectives — only cross-host group p2p is unsupported.
+        raise MpiError(
+            "mpi_tpu: communicator point-to-point between ranks on "
+            "different hosts is not supported by the hybrid driver; use "
+            "the communicator's collectives (hierarchical engines) or "
+            "world-rank send/receive")
     if not 0 <= tag < _MAX_TAG:
         raise MpiError(
             f"mpi_tpu: cross-host tags must be in [0, 2**32), got {tag}")
@@ -77,6 +88,14 @@ class HybridNetwork:
         self._init_done = threading.Event()
         self._init_error: Optional[BaseException] = None
         self._live_ranks = 0  # rank threads inited but not yet finalized
+        # Per-communicator hierarchical engines (see group_collectives).
+        self._group_colls: "OrderedDict[tuple, _HybridGroupEngine]" = \
+            OrderedDict()
+        # Cross-host collective tag sequences per (ctx, members): must
+        # outlive engine eviction (a rebuilt engine restarting at seq 0
+        # while peer hosts kept counting would desync wire tags). Tiny
+        # (one int per communicator ever used), so never evicted.
+        self._grp_seqs: dict = {}
 
     # -- rank binding (delegates to the inner xla driver) ---------------------
 
@@ -166,6 +185,46 @@ class HybridNetwork:
         index in the TCP tier, shared by all its local ranks."""
         return self._tcp.rank()
 
+    def _grp_seq_state(self, ctx: int, members: tuple) -> dict:
+        """The persistent {lock, seq} record backing a group adapter's
+        collective tag sequence. Caller holds no lock; _init_lock guards
+        creation (group_collectives already holds it)."""
+        key = (int(ctx), tuple(members))
+        st = self._grp_seqs.get(key)
+        if st is None:
+            st = self._grp_seqs[key] = {"lock": threading.Lock(), "seq": 0}
+        return st
+
+    def group_collectives(self, members, ctx: int) -> "_HybridGroupEngine":
+        """Hierarchical collective engine for a communicator group (the
+        mpi_tpu.comm dispatch hook, same contract as
+        :meth:`XlaNetwork.group_collectives`): local members share a
+        compiled xla sub-mesh engine, host leaders bridge over the TCP
+        tier. One shared engine per ``(ctx, members)``."""
+        key = (int(ctx), tuple(int(m) for m in members))
+        with self._init_lock:
+            eng = self._group_colls.get(key)
+            if eng is None:
+                eng = _HybridGroupEngine(self, key[1], key[0])
+                self._group_colls[key] = eng
+                while len(self._group_colls) > \
+                        XlaNetwork._GROUP_ENGINE_CACHE:
+                    self._group_colls.popitem(last=False)
+            else:
+                self._group_colls.move_to_end(key)
+        return eng
+
+    def release_group_collectives(self, members, ctx: int) -> None:
+        """Comm.free() hook: drop this group's engine and its inner xla
+        engine (compiled programs, filler buffers)."""
+        key = (int(ctx), tuple(int(m) for m in members))
+        with self._init_lock:
+            eng = self._group_colls.pop(key, None)
+        if eng is not None:
+            self._inner.release_group_collectives(
+                tuple(g - self._my_offset for g in eng._local_members),
+                key[0])
+
     # -- point-to-point -------------------------------------------------------
 
     def send(self, data: Any, dest: int, tag: int) -> None:
@@ -192,168 +251,331 @@ class HybridNetwork:
 
     # -- hierarchical collectives --------------------------------------------
     #
-    # Pattern: local xla collective → host-leader TCP leg → local
-    # distribution. Local rank 0 is always the host leader. All collectives
-    # must be invoked in the same order on every global rank (standard MPI
-    # requirement) — that ordering also serialises the leader's TCP legs.
+    # The world is just the communicator group (0..size) with identity
+    # layout, so every world collective delegates to ONE
+    # _HybridGroupEngine over all ranks (uid 0; inner = the world xla
+    # engine). The local -> host-leader TCP leg -> local shape, the
+    # scatter error envelope, and the reassembly maps therefore exist in
+    # exactly one place (the engine), for world and sub-communicators
+    # alike. All collectives must be invoked in the same order on every
+    # global rank (standard MPI requirement) — that ordering also
+    # serialises the leader's TCP legs.
+
+    def _world_engine(self) -> "_HybridGroupEngine":
+        if self._size == 0:
+            raise MpiError("mpi_tpu: collective before init()")
+        with self._init_lock:
+            eng = getattr(self, "_world_eng", None)
+            if eng is None:
+                eng = _HybridGroupEngine(
+                    self, tuple(range(self._size)), 0)
+                self._world_eng = eng
+            return eng
+
+    def allreduce(self, data: Any, op: str = "sum") -> Any:
+        return self._world_engine().allreduce(data, op=op)
+
+    def reduce(self, data: Any, root: int = 0, op: str = "sum") -> Optional[Any]:
+        return self._world_engine().reduce(data, root=root, op=op)
+
+    def reduce_scatter(self, data: Any, op: str = "sum") -> Any:
+        return self._world_engine().reduce_scatter(data, op=op)
+
+    def barrier(self) -> None:
+        return self._world_engine().barrier()
+
+    def bcast(self, data: Any, root: int = 0) -> Any:
+        return self._world_engine().bcast(data, root=root)
+
+    def allgather(self, data: Any) -> List[Any]:
+        return self._world_engine().allgather(data)
+
+    def gather(self, data: Any, root: int = 0) -> Optional[List[Any]]:
+        return self._world_engine().gather(data, root=root)
+
+    def scatter(self, data: Optional[List[Any]], root: int = 0) -> Any:
+        return self._world_engine().scatter(data, root=root)
+
+    def alltoall(self, data: List[Any]) -> List[Any]:
+        return self._world_engine().alltoall(data)
+
+
+class _TcpGroupAdapter:
+    """Host-leader sub-group view of the TCP tier for one communicator's
+    hierarchical collectives: rank = index in the participating-host
+    list, and collective tags map into an engine-unique block of the far
+    negative tag space ``(-2^63, -2^62]`` — disjoint from user tags,
+    world collective tags (>= 2^48), cross-host composed tags (bit 62),
+    and Comm context regions (> -2^62). ``uid`` must be unique among
+    engines that can share a host link: ``ctx * 2^15 + min(members)``
+    is, because comms sharing a context are disjoint (split siblings),
+    so their lowest members differ. The collective tag sequence
+    (``_coll_seq``, advanced by ``collectives_generic``) is CROSS-HOST
+    state — every participating host's leader must be at the same
+    sequence — so it lives in ``seq_state`` (a per-(ctx, members) dict
+    owned by the driver) and survives engine eviction/rebuild.
+    Offsets wrap modulo ``_BLOCK``: collectives on one communicator are
+    globally ordered and tags are released on completion, so a wrapped
+    offset can only collide with itself 2^17 collectives later."""
+
+    # uid < 2^33 (max ctx 2^18-1) and uid * _BLOCK + off must stay
+    # within (-2^63, -2^62]: 2^33 * 2^29 == 2^62 exactly.
+    _BLOCK = 1 << 29
+
+    def __init__(self, tcp: TcpNetwork, hosts: List[int], uid: int,
+                 seq_state: dict):
+        if not 0 <= uid < (1 << 33):
+            raise MpiError(f"mpi_tpu: group-engine uid {uid} out of range")
+        self._tcp = tcp
+        self._hosts = list(hosts)
+        self._uid = uid
+        self._seq_state = seq_state
+
+    # collectives_generic._next_tag_base reads/writes these on the impl
+    # it is handed; proxy to the driver-owned state so a rebuilt adapter
+    # continues the sequence its cross-host peers are at.
+    @property
+    def _coll_lock(self) -> threading.Lock:
+        return self._seq_state["lock"]
+
+    @property
+    def _coll_seq(self) -> int:
+        return self._seq_state["seq"]
+
+    @_coll_seq.setter
+    def _coll_seq(self, value: int) -> None:
+        self._seq_state["seq"] = value
+
+    def rank(self) -> int:
+        return self._hosts.index(self._tcp.rank())
+
+    def size(self) -> int:
+        return len(self._hosts)
+
+    def _map(self, tag: int) -> int:
+        off = (tag - G.COLL_TAG_BASE) % self._BLOCK
+        return -(1 << 62) - self._uid * self._BLOCK - off - 1
+
+    def send(self, data: Any, dest: int, tag: int) -> None:
+        self._tcp.send(data, self._hosts[dest], self._map(tag))
+
+    def receive(self, source: int, tag: int, out: Optional[Any] = None) -> Any:
+        return self._tcp.receive(self._hosts[source], self._map(tag), out=out)
+
+    def cancel_receive(self, source: int, tag: int) -> bool:
+        return self._tcp.cancel_receive(self._hosts[source], self._map(tag))
+
+
+class _HybridGroupEngine:
+    """Hierarchical collectives for one communicator over the hybrid
+    driver: local members run the xla driver's compiled sub-mesh engine,
+    host leaders (first local member in group order) bridge hosts over
+    the TCP tier, and results fan back out through the local engine —
+    the same local → leader-leg → local shape as the world collectives,
+    with explicit group-rank maps because a key-permuted split need not
+    keep hosts contiguous. The full suite is defined here except
+    scan/exscan, whose generic algorithms ride :meth:`allgather` (via
+    ``collectives_generic._allgather_best`` on the Comm) — never
+    cross-host p2p, which the hybrid driver rejects for communicator
+    tags."""
+
+    def __init__(self, net: "HybridNetwork", members: tuple, ctx: int):
+        self._net = net
+        self._members = tuple(members)
+        h = net._tcp.rank()
+        self._local_members = [g for g in self._members
+                               if net._host_of(g) == h]
+        if not self._local_members:
+            raise MpiError(
+                "mpi_tpu: hybrid group engine built on a host with no "
+                "group members")
+        self._hosts = sorted({net._host_of(g) for g in self._members})
+        local_ranks = tuple(g - net._my_offset for g in self._local_members)
+        if local_ranks == tuple(range(net._local_n)):
+            # Full local membership in natural order: the driver's world
+            # xla engine IS this group's inner engine (don't duplicate
+            # its jit cache / rendezvous barrier).
+            self._inner = net._inner
+        else:
+            self._inner = net._inner.group_collectives(local_ranks, ctx)
+        self._tcp_grp = _TcpGroupAdapter(
+            net._tcp, self._hosts, ctx * _MAX_GLOBAL + min(self._members),
+            net._grp_seq_state(ctx, self._members))
+        # group rank of each local member, in local (inner) order
+        self._local_granks = [self._members.index(g)
+                              for g in self._local_members]
+
+    # -- helpers -----------------------------------------------------------
+
+    def _is_leader(self) -> bool:
+        return self._net.rank() == self._local_members[0]
 
     def _leader_leg(self, local_result: Any,
                     leg: Callable[[Any], Any]) -> Any:
-        """Run ``leg`` on the host leader only, then share its result with
-        every local rank (via the inner driver's bcast)."""
-        if self._nhosts() == 1:
+        if len(self._hosts) == 1:
             return local_result
-        out = leg(local_result) if self._local() == 0 else None
+        out = leg(local_result) if self._is_leader() else None
         return self._inner.bcast(out, root=0)
 
-    def _nhosts(self) -> int:
-        return len(self._counts)
+    # -- collectives -------------------------------------------------------
 
     def allreduce(self, data: Any, op: str = "sum") -> Any:
         G.check_op(op)
         local_total = self._inner.allreduce(data, op=op)
         return self._leader_leg(
-            local_total, lambda t: G.allreduce(self._tcp, t, op=op))
+            local_total, lambda t: G.allreduce(self._tcp_grp, t, op=op))
 
-    def reduce(self, data: Any, root: int = 0, op: str = "sum") -> Optional[Any]:
+    def reduce(self, data: Any, root: int = 0, op: str = "sum"
+               ) -> Optional[Any]:
         result = self.allreduce(data, op=op)
-        return result if self.rank() == root else None
-
-    def reduce_scatter(self, data: Any, op: str = "sum") -> Any:
-        """Hierarchical allreduce, then keep this *global* rank's block
-        (leading axis split across all ranks of all hosts)."""
-        import numpy as _np
-
-        arr = _np.asarray(data)
-        if arr.ndim < 1 or arr.shape[0] % self._size:
-            raise MpiError(
-                f"mpi_tpu: reduce_scatter payload leading axis "
-                f"{arr.shape if arr.ndim else 'scalar'} must divide into "
-                f"{self._size} equal blocks")
-        total = _np.asarray(self.allreduce(data, op=op))
-        m = arr.shape[0] // self._size
-        r = self.rank()
-        return total[r * m:(r + 1) * m]
+        me = self._members.index(self._net.rank())
+        return result if me == root else None
 
     def barrier(self) -> None:
         self._inner.barrier()
-        if self._local() == 0 and self._nhosts() > 1:
-            G.barrier(self._tcp)
+        if self._is_leader() and len(self._hosts) > 1:
+            G.barrier(self._tcp_grp)
         self._inner.barrier()
 
     def bcast(self, data: Any, root: int = 0) -> Any:
-        h = self._host_of(root)
-        if h == self._tcp.rank():
-            payload = self._inner.bcast(data, root=root - self._my_offset)
-            if self._local() == 0 and self._nhosts() > 1:
-                G.bcast(self._tcp, payload, root=h)
+        g_root = self._members[root]
+        root_host = self._net._host_of(g_root)
+        if root_host == self._net._tcp.rank():
+            payload = self._inner.bcast(
+                data, root=self._local_members.index(g_root))
+            if self._is_leader() and len(self._hosts) > 1:
+                G.bcast(self._tcp_grp, payload,
+                        root=self._hosts.index(root_host))
             return payload
-        # Non-root host: leader receives over TCP, then local bcast.
         payload = None
-        if self._local() == 0:
-            payload = G.bcast(self._tcp, None, root=h)
+        if self._is_leader():
+            payload = G.bcast(self._tcp_grp, None,
+                              root=self._hosts.index(root_host))
         return self._inner.bcast(payload, root=0)
 
     def allgather(self, data: Any) -> List[Any]:
         locals_ = self._inner.allgather(data)
 
         def leg(locals_list: List[Any]) -> List[Any]:
-            per_host = G.allgather(self._tcp, locals_list)
-            flat: List[Any] = []
-            for chunk in per_host:
-                flat.extend(chunk)
-            return flat
+            # Tag each payload with its group rank: a key-permuted split
+            # can interleave hosts arbitrarily in group order.
+            tagged = list(zip(self._local_granks, locals_list))
+            per_host = G.allgather(self._tcp_grp, tagged)
+            flat = [p for chunk in per_host for p in chunk]
+            flat.sort(key=lambda e: e[0])
+            return [p for _, p in flat]
 
         return self._leader_leg(locals_, leg)
 
     def gather(self, data: Any, root: int = 0) -> Optional[List[Any]]:
         result = self.allgather(data)
-        return result if self.rank() == root else None
+        me = self._members.index(self._net.rank())
+        return result if me == root else None
+
+    def reduce_scatter(self, data: Any, op: str = "sum") -> Any:
+        """Hierarchical allreduce, then keep this group rank's block."""
+        import numpy as _np
+
+        n = len(self._members)
+        arr = _np.asarray(data)
+        if arr.ndim < 1 or arr.shape[0] % n:
+            raise MpiError(
+                f"mpi_tpu: reduce_scatter payload leading axis "
+                f"{arr.shape if arr.ndim else 'scalar'} must divide into "
+                f"{n} equal blocks")
+        total = _np.asarray(self.allreduce(data, op=op))
+        m = arr.shape[0] // n
+        me = self._members.index(self._net.rank())
+        return total[me * m:(me + 1) * m]
+
+    def _host_chunk(self, items: List[Any], host: int) -> List[Any]:
+        """items (ordered by group rank) restricted to ``host``'s members,
+        in that host's local (inner) order."""
+        return [items[gr] for gr, g in enumerate(self._members)
+                if self._net._host_of(g) == host]
 
     def scatter(self, data: Optional[List[Any]], root: int = 0) -> Any:
-        h = self._host_of(root)
-        # The TCP leg always carries a ``(status, payload)`` envelope so an
-        # invalid list raises a clean MpiError on *every* rank of *every*
-        # host — the leaders relay the verdict over TCP and then to their
-        # local ranks via the inner bcast, so nobody commits to a blocking
-        # scatter that will never be fed.
-        if h == self._tcp.rank():
-            # Move the item list to the host leader (one gather hop, not a
-            # full local bcast), chunk per host, TCP scatter the chunks,
-            # then local scatter.
-            gathered = self._inner.gather(data, root=0)
-            chunk = None
-            items = None
-            error = None
-            if self._local() == 0:
-                items = gathered[root - self._my_offset]
-                if items is None or len(items) != self._size:
-                    error = (f"mpi_tpu: scatter root needs a list of "
-                             f"exactly {self._size} payloads")
-                if self._nhosts() > 1:
-                    if error is not None:
-                        envelopes = [("err", error)] * self._nhosts()
-                    else:
-                        envelopes = [
-                            ("ok", items[self._offsets[i]:
-                                         self._offsets[i] + self._counts[i]])
-                            for i in range(self._nhosts())
-                        ]
-                    G.scatter(self._tcp, envelopes, root=h)
-            error = self._inner.bcast(error, root=0)
-            if error is not None:
-                raise MpiError(error)
-            if self._local() == 0:
-                chunk = items[self._my_offset:
-                              self._my_offset + self._local_n]
-            return self._inner.scatter(chunk, root=0)
+        """Root's per-group-rank list → one inner gather hop to root's
+        host leader, per-host chunks over TCP, local scatter. The TCP
+        leg carries a (status, payload) envelope so a bad list raises on
+        every member instead of deadlocking (same shape as the world
+        scatter)."""
+        n = len(self._members)
+        g_root = self._members[root]
+        root_host = self._net._host_of(g_root)
+        multi = len(self._hosts) > 1
         chunk = None
         error = None
-        if self._local() == 0:
-            status, payload = G.scatter(self._tcp, None, root=h)
-            if status == "err":
-                error = payload
-            else:
-                chunk = payload
+        if root_host == self._net._tcp.rank():
+            gathered = self._inner.gather(
+                data, root=0)  # leader collects local members' args
+            items = None
+            if self._is_leader():
+                items = gathered[self._local_members.index(g_root)]
+                if items is None or len(items) != n:
+                    error = (f"mpi_tpu: scatter root needs a list of "
+                             f"exactly {n} payloads")
+                if multi:
+                    if error is not None:
+                        envelopes = [("err", error)] * len(self._hosts)
+                    else:
+                        envelopes = [("ok", self._host_chunk(items, hh))
+                                     for hh in self._hosts]
+                    G.scatter(self._tcp_grp, envelopes,
+                              root=self._hosts.index(root_host))
+                if error is None:
+                    chunk = self._host_chunk(items, root_host)
+        else:
+            if self._is_leader():
+                status, payload = G.scatter(
+                    self._tcp_grp, None, root=self._hosts.index(root_host))
+                if status == "err":
+                    error = payload
+                else:
+                    chunk = payload
         error = self._inner.bcast(error, root=0)
         if error is not None:
             raise MpiError(error)
         return self._inner.scatter(chunk, root=0)
 
     def alltoall(self, data: List[Any]) -> List[Any]:
-        if len(data) != self._size:
+        """Rows to host bundles over TCP, reassembled per local member in
+        group-rank order (world alltoall generalized to non-contiguous
+        group layouts)."""
+        n = len(self._members)
+        if len(data) != n:
             raise MpiError(
-                f"mpi_tpu: alltoall needs exactly {self._size} payloads, "
-                f"got {len(data)}")
-        # Local matrix: rows[l] = payload list of local rank l.
-        rows = self._inner.allgather(data)
+                f"mpi_tpu: alltoall needs exactly {n} payloads, got "
+                f"{len(data)}")
+        rows = self._inner.allgather(data)  # [local idx] -> n-list
+        if len(self._hosts) == 1:
+            me_local = self._local_members.index(self._net.rank())
+            my_g = self._local_granks[me_local]
+            return [row[my_g] for row in rows]
 
-        def leg(rows_: List[List[Any]]) -> List[List[Any]]:
-            # bundles[h] = what this host sends to host h: rows sliced to
-            # h's global-rank span (still indexed [local_src][dst_in_h]).
-            bundles = [
-                [row[self._offsets[h]:self._offsets[h] + self._counts[h]]
-                 for row in rows_]
-                for h in range(self._nhosts())
-            ]
-            received = G.alltoall(self._tcp, bundles)
-            # received[hs][ls][l] = payload from global (hs, ls) to my
-            # local rank l. Reassemble per local rank in global src order.
+        def leg(rows_: List[List[Any]]) -> Optional[List[List[Any]]]:
+            # bundles[h] = (src group ranks here, rows sliced to h's
+            # members); sources are tagged so the receiver can reorder.
+            bundles = []
+            for hh in self._hosts:
+                dst_granks = [gr for gr, g in enumerate(self._members)
+                              if self._net._host_of(g) == hh]
+                bundles.append([
+                    (src_g, [row[d] for d in dst_granks])
+                    for src_g, row in zip(self._local_granks, rows_)
+                ])
+            received = G.alltoall(self._tcp_grp, bundles)
+            # received[h] = list of (src_grank, payloads-for-my-members)
+            per_src: List[tuple] = sorted(
+                (entry for chunk in received for entry in chunk),
+                key=lambda e: e[0])
             out_rows = []
-            for l in range(self._local_n):
-                out: List[Any] = []
-                for hs in range(self._nhosts()):
-                    for ls in range(self._counts[hs]):
-                        out.append(received[hs][ls][l])
-                out_rows.append(out)
+            for li in range(len(self._local_members)):
+                out_rows.append([payloads[li] for _, payloads in per_src])
             return out_rows
 
-        if self._nhosts() > 1:
-            # Leader reassembles, then each local rank gets only its own
-            # row (scatter, not bcast — rows can be large).
-            out_rows = leg(rows) if self._local() == 0 else None
-            return self._inner.scatter(out_rows, root=0)
-        return [row[self._local()] for row in rows]
+        out_rows = leg(rows) if self._is_leader() else None
+        return self._inner.scatter(out_rows, root=0)
 
 
 def run_spmd_hybrid(fn: Callable[[], Any], net: HybridNetwork,

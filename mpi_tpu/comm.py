@@ -228,6 +228,12 @@ class Comm:
             raise MpiError(
                 f"mpi_tpu: tag {tag} out of range for a sub-communicator "
                 f"(user tags must be in [0, 2^40))")
+        if (self._ctx + 1) * CTX_SPAN > (1 << 62):
+            # Regions below -2^62 belong to the hybrid driver's group-
+            # engine TCP blocks; ~2^18 contexts per run is the cap.
+            raise MpiError(
+                f"mpi_tpu: communicator context space exhausted "
+                f"(ctx={self._ctx})")
         return -((self._ctx + 1) * CTX_SPAN) + offset
 
     def _check_peer(self, peer: int) -> None:

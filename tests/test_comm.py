@@ -519,11 +519,16 @@ class TestTcpDriver:
             == "unix"
 
     def test_tag_mapping_fits_wire_i64(self):
-        # Highest-magnitude mapped tag must fit the frame's i64.
+        # Highest legal context still fits the frame's i64 and stays
+        # above the hybrid group-engine block space at -2^62...
         c = Comm.__new__(Comm)
         c._impl = None
         c._members = (0, 1)
-        c._ctx = (1 << 18)  # absurdly many communicators
+        c._ctx = (1 << 62) // CTX_SPAN - 1  # max legal context
         c._world_to_group = {0: 0, 1: 1}
         t = c._map_tag(USER_TAG_SPAN - 1)
-        assert -(1 << 63) <= t < 0
+        assert -(1 << 62) <= t < 0
+        # ...and one past it raises instead of colliding with that space.
+        c._ctx += 1
+        with pytest.raises(mpi_tpu.MpiError, match="context space"):
+            c._map_tag(0)
