@@ -562,20 +562,29 @@ def _allreduce_on_virtual_mesh(size_bytes: int) -> dict:
             if k.endswith("_gbps") or k.endswith("_p50_us")}
 
 
+# Measurements already completed this run — the watchdog ships them in
+# its error line so a late device hang doesn't discard the host-side
+# legs that did finish.
+_PARTIALS: dict = {}
+
+
 def _install_watchdog(seconds: float) -> threading.Timer:
     """Guarantee the one-JSON-line stdout contract even if the device
     hangs: a jax call stuck on an unresponsive TPU/tunnel blocks forever
     and cannot be interrupted from Python, so after ``seconds`` this
-    prints an error-marked JSON line and hard-exits (``os._exit`` — the
-    stuck runtime threads cannot be joined). Tune/disable with
-    ``MPI_TPU_BENCH_DEADLINE_S`` (0 disables)."""
+    prints an error-marked JSON line (carrying any measurements that DID
+    complete) and hard-exits (``os._exit`` — the stuck runtime threads
+    cannot be joined). Tune/disable with ``MPI_TPU_BENCH_DEADLINE_S``
+    (0 disables)."""
     def fire() -> None:
-        print(json.dumps({
+        line = {
             "metric": "train_step_mfu", "value": 0.0, "unit": "pct",
             "vs_baseline": 0.0,
             "error": f"bench watchdog fired after {seconds:.0f}s — "
                      f"device/tunnel unresponsive",
-        }), flush=True)
+        }
+        line.update(_PARTIALS)
+        print(json.dumps(line), flush=True)
         os._exit(3)
 
     t = threading.Timer(seconds, fire)
@@ -617,37 +626,48 @@ def main() -> int:
     watchdog = _install_watchdog(deadline) if deadline > 0 else None
 
     # TCP bounce first: subprocesses, no device contention with the rest.
+    # Every completed leg lands in _PARTIALS immediately, so the
+    # watchdog's error line carries whatever finished before a hang.
     tcp_us = bounce_tcp()
     xla_us = bounce_xla()
-    dev_bounce = bounce_device((1 << 14) if smoke else BOUNCE_SIZE)
+    bounce_keys = {
+        "bounce_tcp_us": round(tcp_us, 1),
+        "bounce_xla_us": round(xla_us, 1),
+        "bounce_speedup": round(tcp_us / xla_us, 1),
+    }
+    _PARTIALS.update(bounce_keys)
+    bounce_keys.update(bounce_device((1 << 14) if smoke else BOUNCE_SIZE))
+    _PARTIALS.update(bounce_keys)
     ar_size = (1 << 20) if smoke else (256 << 20)
     if smoke:
         result = measure_train_step(d_model=64, n_layers=2, n_heads=4,
                                     d_ff=128, vocab=128, batch=2, seq=64,
                                     short=1, long=3)
+        _PARTIALS.update(result)
         result.update(measure_long_context(
             seq=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
             vocab=128, short=1, long=3))
+        _PARTIALS.update(result)
         result.update(measure_decode(
             d_model=64, n_layers=2, n_heads=4, d_ff=128, vocab=128,
             batch=2, prompt_len=16, short=4, long=12))
     else:
         result = measure_train_step()
+        _PARTIALS.update(result)
         result.update(measure_long_context())
+        _PARTIALS.update(result)
         result.update(measure_decode())
+    _PARTIALS.update(result)
     ar = measure_allreduce(ar_size)
+    _PARTIALS.update(ar)
     if ar.get("allreduce_devices") == 1:
         # Single chip: the in-process collective is the identity (keys
         # are null); measure the real multi-device path on a virtual
         # 8-device mesh instead.
         ar.update(_allreduce_on_virtual_mesh(ar_size))
+        _PARTIALS.update(ar)
     result.update(ar)
-    result.update({
-        "bounce_tcp_us": round(tcp_us, 1),
-        "bounce_xla_us": round(xla_us, 1),
-        "bounce_speedup": round(tcp_us / xla_us, 1),
-    })
-    result.update(dev_bounce)
+    result.update(bounce_keys)
     if "--suite" in sys.argv:
         allreduce_sweep()
 

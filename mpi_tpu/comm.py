@@ -166,13 +166,35 @@ class Comm:
     def send(self, data: Any, dest: int, tag: int) -> None:
         """Blocking rendezvous send to group rank ``dest``."""
         self._check_peer(dest)
-        self._impl.send(data, self._members[dest], self._map_tag(tag))
+        from .utils import trace
+
+        if not trace.enabled():
+            return self._impl.send(data, self._members[dest],
+                                   self._map_tag(tag))
+        from .api import _payload_bytes
+
+        trace.count("comm.send.calls")
+        trace.count("comm.send.bytes", _payload_bytes(data))
+        with trace.span("mpi.send", ctx=self._ctx, dest=dest, tag=tag):
+            self._impl.send(data, self._members[dest], self._map_tag(tag))
 
     def receive(self, source: int, tag: int, out: Optional[Any] = None) -> Any:
         """Blocking receive from group rank ``source``."""
         self._check_peer(source)
-        return self._impl.receive(self._members[source], self._map_tag(tag),
-                                  out=out)
+        from .utils import trace
+
+        if not trace.enabled():
+            return self._impl.receive(self._members[source],
+                                      self._map_tag(tag), out=out)
+        from .api import _payload_bytes
+
+        with trace.span("mpi.receive", ctx=self._ctx, source=source,
+                        tag=tag):
+            result = self._impl.receive(self._members[source],
+                                        self._map_tag(tag), out=out)
+        trace.count("comm.receive.calls")
+        trace.count("comm.receive.bytes", _payload_bytes(result))
+        return result
 
     def cancel_receive(self, source: int, tag: int) -> bool:
         """Forwarded so :func:`mpi_tpu.api.exchange` can clean up a posted
@@ -190,7 +212,15 @@ class Comm:
         sequential send-then-receive would rendezvous-deadlock)."""
         self._check_peer(dest)
         self._check_peer(source)
-        return _exchange(self, data, dest, source, tag, out=out)
+        from .utils import trace
+
+        if not trace.enabled():
+            return _exchange(self, data, dest, source, tag, out=out)
+        # The engine's two legs run through the traced send/receive
+        # above; this span groups them like the facade's mpi.sendrecv.
+        with trace.span("mpi.sendrecv", ctx=self._ctx, dest=dest,
+                        source=source, tag=tag):
+            return _exchange(self, data, dest, source, tag, out=out)
 
     def isend(self, data: Any, dest: int, tag: int) -> Request:
         """Nonblocking group send; ``wait()`` blocks until the rendezvous
@@ -278,6 +308,26 @@ class Comm:
     # generic algorithms over the translated SPI (self).
 
     def _coll(self, name: str, *args: Any, **kwargs: Any) -> Any:
+        from .utils import trace
+
+        if not trace.enabled():
+            return self._coll_inner(name, *args, **kwargs)
+        from .api import _payload_bytes
+
+        trace.count(f"comm.{name}.calls")
+        if args:
+            trace.count(f"comm.{name}.bytes", _payload_bytes(args[0]))
+        # Note: when a group collective falls back to the generic
+        # algorithms, its internal rounds go through the traced
+        # send/receive above, so that traffic is additionally visible
+        # under comm.send/receive — unlike world collectives, whose
+        # generic rounds hit the driver directly. Deliberate: the extra
+        # visibility is worth more than symmetric counters.
+        with trace.span(f"mpi.{name}", ctx=self._ctx,
+                        group_size=len(self._members)):
+            return self._coll_inner(name, *args, **kwargs)
+
+    def _coll_inner(self, name: str, *args: Any, **kwargs: Any) -> Any:
         from . import collectives_generic as gen
 
         if self._ctx == 0:

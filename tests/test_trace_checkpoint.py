@@ -82,6 +82,33 @@ class TestTrace:
         names = {e["name"] for e in trace.events()}
         assert {"mpi.send", "mpi.receive", "mpi.allreduce"} <= names
 
+    def test_communicator_comm_accounting(self):
+        from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+        trace.enable()
+
+        def main():
+            mpi_tpu.init()
+            sub = mpi_tpu.comm_world().split(color=0)
+            if sub.rank() == 0:
+                sub.send(np.zeros(4, np.float32), 1, tag=2)
+            else:
+                sub.receive(source=0, tag=2)
+            sub.allreduce(np.ones((2,), np.float32))
+            mpi_tpu.finalize()
+
+        run_spmd(main, net=XlaNetwork(n=2))
+        cts = trace.counters()
+        assert cts["comm.send.calls"] == 1
+        assert cts["comm.send.bytes"] == 16
+        assert cts["comm.receive.calls"] == 1
+        assert cts["comm.allreduce.calls"] == 2
+        # split's membership allgather is itself a traced collective
+        assert cts["comm.allgather.calls"] == 2
+        ctxs = {e.get("ctx") for e in trace.events()
+                if e["name"] == "mpi.allreduce"}
+        assert any(c is not None and c >= 1 for c in ctxs)
+
 
 class TestCheckpoint:
     def _state(self, key=0):
