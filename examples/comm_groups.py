@@ -59,6 +59,18 @@ def main() -> None:
                 f"rank {rank}: row/col reduction mismatch: "
                 f"({row_sum}, {col_sum}) != ({expect_row}, {expect_col})")
 
+        # The same grid as a Cartesian topology (MPI_Cart_create):
+        # coords match the manual divmod layout, and a periodic shift
+        # along the column axis runs a halo exchange ring.
+        cart = mpi_tpu.cart_create(world, (rows, cols),
+                                   periods=(True, True))
+        assert cart.coords() == (row, col)
+        src, dst = cart.shift(1, 1)  # pass right along the row, wrap
+        halo = cart.sendrecv(rank, dest=dst, source=src, tag=3)
+        if int(halo) != row * cols + (col - 1) % cols:
+            raise SystemExit(f"rank {rank}: halo mismatch: {halo}")
+        assert cart.sub((False, True)).members == row_comm.members
+
         # Column leaders gather their column's sums to rank 0 for output.
         if col_comm.rank() == 0:
             all_col_sums = row_comm.gather(col_sum, root=0)

@@ -20,7 +20,7 @@ point-to-point library over TCP, /root/reference) designed TPU-first:
     ted SPMD code, plus Pallas ring/DMA kernels (:mod:`mpi_tpu.ops`).
 """
 
-from .comm import Comm, comm_world
+from .comm import CartComm, Comm, cart_create, comm_world
 from .runner import run_main, selected_backend
 from .api import (
     Interface,
@@ -58,6 +58,8 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Comm",
+    "CartComm",
+    "cart_create",
     "comm_world",
     "run_main",
     "selected_backend",

@@ -50,7 +50,8 @@ from typing import Any, List, Optional, Tuple
 
 from .api import Interface, MpiError, Request, exchange as _exchange
 
-__all__ = ["Comm", "comm_world", "CTX_SPAN", "USER_TAG_SPAN"]
+__all__ = ["Comm", "CartComm", "cart_create", "comm_world", "CTX_SPAN",
+           "USER_TAG_SPAN"]
 
 CTX_SPAN = 1 << 44        # tag-space region per context
 USER_TAG_SPAN = 1 << 40   # user tags within a region: [0, 2^40)
@@ -163,8 +164,14 @@ class Comm:
     def size(self) -> int:
         return len(self._members)
 
-    def send(self, data: Any, dest: int, tag: int) -> None:
-        """Blocking rendezvous send to group rank ``dest``."""
+    def send(self, data: Any, dest: Optional[int], tag: int) -> None:
+        """Blocking rendezvous send to group rank ``dest``.
+
+        ``dest=None`` is PROC_NULL (the value :meth:`CartComm.shift`
+        yields at a non-periodic edge): the send is a no-op, per MPI
+        semantics — halo-exchange loops need no edge special-casing."""
+        if dest is None:
+            return
         self._check_peer(dest)
         from .utils import trace
 
@@ -178,8 +185,14 @@ class Comm:
         with trace.span("mpi.send", ctx=self._ctx, dest=dest, tag=tag):
             self._impl.send(data, self._members[dest], self._map_tag(tag))
 
-    def receive(self, source: int, tag: int, out: Optional[Any] = None) -> Any:
-        """Blocking receive from group rank ``source``."""
+    def receive(self, source: Optional[int], tag: int,
+                out: Optional[Any] = None) -> Any:
+        """Blocking receive from group rank ``source``.
+
+        ``source=None`` is PROC_NULL: completes immediately and returns
+        ``None`` (MPI's receive-from-MPI_PROC_NULL contract)."""
+        if source is None:
+            return None
         self._check_peer(source)
         from .utils import trace
 
@@ -206,10 +219,21 @@ class Comm:
         self._check_peer(source)
         return cancel(self._members[source], self._map_tag(tag))
 
-    def sendrecv(self, data: Any, dest: int, source: int, tag: int,
+    def sendrecv(self, data: Any, dest: Optional[int],
+                 source: Optional[int], tag: int,
                  out: Optional[Any] = None) -> Any:
         """Concurrent send+receive within the group (deadlock-free where
-        sequential send-then-receive would rendezvous-deadlock)."""
+        sequential send-then-receive would rendezvous-deadlock).
+        ``None`` on either side is PROC_NULL: that leg is skipped (a
+        None source yields a ``None`` result) — so a non-periodic
+        :meth:`CartComm.shift` pair drops straight in."""
+        if dest is None and source is None:
+            return None
+        if dest is None:
+            return self.receive(source, tag, out=out)
+        if source is None:
+            self.send(data, dest, tag)
+            return None
         self._check_peer(dest)
         self._check_peer(source)
         from .utils import trace
@@ -456,3 +480,136 @@ def comm_world(impl: Optional[Interface] = None) -> Comm:
     if impl is None:
         impl = api._require_init()
     return Comm(impl, tuple(range(impl.size())), 0)
+
+
+class CartComm(Comm):
+    """Cartesian-topology communicator (MPI_Cart_create family).
+
+    Group ranks are laid out row-major over ``dims`` (the MPI
+    convention: the LAST dimension varies fastest), each optionally
+    periodic. Everything a :class:`Comm` does still works; on top of it:
+    :meth:`coords`/:meth:`rank_of` translate between ranks and grid
+    coordinates, :meth:`shift` yields the (source, dest) pair for a
+    displacement along one axis (``None`` standing in for MPI_PROC_NULL
+    at a non-periodic edge), and :meth:`sub` (MPI_Cart_sub) slices the
+    grid into lower-dimensional Cartesian communicators. The mesh-axis
+    analogy is direct: a ``CartComm`` is the host-side mirror of a
+    ``jax.sharding.Mesh``'s named axes, so halo exchanges and per-axis
+    collectives can be written against the same grid either way."""
+
+    def __init__(self, impl: Interface, members: Tuple[int, ...], ctx: int,
+                 dims: Tuple[int, ...], periods: Tuple[bool, ...]):
+        super().__init__(impl, members, ctx)
+        self._dims = tuple(int(d) for d in dims)
+        self._periods = tuple(bool(p) for p in periods)
+        _check_cart_shape(self._dims, self._periods, len(members))
+
+    @property
+    def dims(self) -> Tuple[int, ...]:
+        return self._dims
+
+    @property
+    def periods(self) -> Tuple[bool, ...]:
+        return self._periods
+
+    def coords(self, rank: Optional[int] = None) -> Tuple[int, ...]:
+        """Grid coordinates of ``rank`` (default: this rank)."""
+        r = self.rank() if rank is None else rank
+        self._check_peer(r)
+        out = []
+        for d in reversed(self._dims):
+            out.append(r % d)
+            r //= d
+        return tuple(reversed(out))
+
+    def rank_of(self, coords) -> int:
+        """Group rank at ``coords`` (row-major; periodic axes wrap)."""
+        if len(coords) != len(self._dims):
+            raise MpiError(
+                f"mpi_tpu: expected {len(self._dims)} coords, got "
+                f"{len(coords)}")
+        r = 0
+        for c, d, p in zip(coords, self._dims, self._periods):
+            c = int(c)
+            if p:
+                c %= d
+            elif not 0 <= c < d:
+                raise MpiError(
+                    f"mpi_tpu: coordinate {c} out of range [0, {d}) on a "
+                    f"non-periodic axis")
+            r = r * d + c
+        return r
+
+    def shift(self, axis: int, disp: int = 1
+              ) -> Tuple[Optional[int], Optional[int]]:
+        """(source, dest) group ranks for a ``disp`` displacement along
+        ``axis`` (MPI_Cart_shift): ``dest`` is where this rank's data
+        goes, ``source`` is whose data arrives here. ``None`` marks the
+        edge of a non-periodic axis (MPI_PROC_NULL)."""
+        if not 0 <= axis < len(self._dims):
+            raise MpiError(f"mpi_tpu: cart axis {axis} out of range")
+        me = list(self.coords())
+
+        def at(offset: int) -> Optional[int]:
+            c = me[axis] + offset
+            if not self._periods[axis] and not 0 <= c < self._dims[axis]:
+                return None
+            trial = list(me)
+            trial[axis] = c
+            return self.rank_of(trial)
+
+        return at(-disp), at(disp)
+
+    def sub(self, keep) -> "CartComm":
+        """Slice the grid (MPI_Cart_sub): ranks sharing coordinates on
+        the DROPPED axes form one lower-dimensional CartComm each,
+        keeping the kept axes' layout and periodicity. Collective."""
+        if len(keep) != len(self._dims):
+            raise MpiError(
+                f"mpi_tpu: keep mask needs {len(self._dims)} entries")
+        me = self.coords()
+        color = key = 0
+        for c, d, k in zip(me, self._dims, keep):
+            if k:
+                key = key * d + c
+            else:
+                color = color * d + c
+        child = self.split(color=color, key=key)
+        assert child is not None
+        kept_dims = tuple(d for d, k in zip(self._dims, keep) if k)
+        kept_periods = tuple(p for p, k in zip(self._periods, keep) if k)
+        return CartComm(child._impl, child._members, child._ctx,
+                        kept_dims or (1,), kept_periods or (False,))
+
+
+def _check_cart_shape(dims: Tuple[int, ...], periods: Tuple[bool, ...],
+                      size: int) -> None:
+    """Shape validation shared by cart_create and CartComm.__init__ —
+    called BEFORE any collective so an invalid shape fails for free
+    instead of after a membership allgather that leaks a context."""
+    if len(dims) != len(periods):
+        raise MpiError("mpi_tpu: dims/periods length mismatch")
+    n = 1
+    for d in dims:
+        if d < 1:
+            raise MpiError(f"mpi_tpu: cart dims must be >= 1, got {dims}")
+        n *= d
+    if n != size:
+        raise MpiError(
+            f"mpi_tpu: cart dims {dims} cover {n} ranks, communicator "
+            f"has {size}")
+
+
+def cart_create(comm: Comm, dims, periods=None) -> CartComm:
+    """A Cartesian communicator over ``comm``'s ranks (MPI_Cart_create
+    with ``reorder=false`` — rank order is preserved). Collective:
+    every member must call it. ``periods`` is a bool per axis (default
+    all False)."""
+    dims = tuple(int(d) for d in dims)
+    if periods is None:
+        periods = (False,) * len(dims)
+    periods = tuple(bool(p) for p in periods)
+    _check_cart_shape(dims, periods, comm.size())
+    base = comm.split(color=0, key=comm.rank())
+    assert base is not None
+    return CartComm(base._impl, base._members, base._ctx, dims, periods)

@@ -465,6 +465,126 @@ class TestGroupOps:
                 got == ["tok", (g - 1) % len(members)]
 
 
+class TestCartesian:
+    def test_coords_rank_roundtrip_and_layout(self):
+        def main():
+            mpi_tpu.init()
+            cart = mpi_tpu.cart_create(comm_world(), (2, 4))
+            r = cart.rank()
+            res = (cart.coords(), cart.rank_of(cart.coords()) == r,
+                   cart.dims, [cart.coords(i) for i in range(8)])
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main)
+        # Row-major: last dim varies fastest.
+        expect = [(i // 4, i % 4) for i in range(8)]
+        for r, (c, ok, dims, allc) in enumerate(out):
+            assert c == expect[r] and ok and dims == (2, 4)
+            assert allc == expect
+
+    def test_shift_periodic_and_edge(self):
+        def main():
+            mpi_tpu.init()
+            cart = mpi_tpu.cart_create(comm_world(), (2, 4),
+                                       periods=(False, True))
+            res = (cart.shift(0, 1), cart.shift(1, 1))
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main)
+        for r in range(8):
+            row, col = divmod(r, 4)
+            (src0, dst0), (src1, dst1) = out[r]
+            # axis 0 non-periodic: edges get None
+            assert src0 == (None if row == 0 else r - 4)
+            assert dst0 == (None if row == 1 else r + 4)
+            # axis 1 periodic ring within the row
+            assert src1 == row * 4 + (col - 1) % 4
+            assert dst1 == row * 4 + (col + 1) % 4
+
+    def test_sub_slices_rows_and_cols(self):
+        def main():
+            mpi_tpu.init()
+            cart = mpi_tpu.cart_create(comm_world(), (2, 4),
+                                       periods=(True, True))
+            rows = cart.sub((False, True))   # keep axis 1 -> row comms
+            cols = cart.sub((True, False))   # keep axis 0 -> col comms
+            res = (rows.dims, rows.members, rows.periods,
+                   cols.dims, cols.members,
+                   float(rows.allreduce(np.float32(cart.rank()))))
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main)
+        for r in range(8):
+            row, col = divmod(r, 4)
+            rdims, rmembers, rper, cdims, cmembers, rsum = out[r]
+            assert rdims == (4,) and rper == (True,)
+            assert rmembers == tuple(range(row * 4, row * 4 + 4))
+            assert cdims == (2,)
+            assert cmembers == (col, col + 4)
+            assert rsum == float(sum(range(row * 4, row * 4 + 4)))
+
+    def test_halo_exchange_ring(self):
+        """1D periodic halo exchange: everyone passes its payload right
+        and receives from the left via shift + sendrecv."""
+        def main():
+            mpi_tpu.init()
+            cart = mpi_tpu.cart_create(comm_world(), (8,), periods=(True,))
+            src, dst = cart.shift(0, 1)
+            got = cart.sendrecv(("halo", cart.rank()), dest=dst,
+                                source=src, tag=1)
+            mpi_tpu.finalize()
+            return tuple(got)
+
+        out = spmd(main)
+        for r in range(8):
+            assert out[r] == ("halo", (r - 1) % 8)
+
+    def test_halo_exchange_nonperiodic_proc_null(self):
+        """Edge ranks get None (PROC_NULL) from shift; p2p treats it as
+        a no-op leg, so the same halo loop works at the boundary: the
+        left edge receives nothing (None), the right edge sends
+        nowhere."""
+        def main():
+            mpi_tpu.init()
+            cart = mpi_tpu.cart_create(comm_world(), (4,),
+                                       periods=(False,))
+            src, dst = cart.shift(0, 1)
+            got = cart.sendrecv(cart.rank(), dest=dst, source=src, tag=1)
+            # Explicit PROC_NULL p2p is also a no-op.
+            cart.send(b"void", None, 7)
+            assert cart.receive(None, 7) is None
+            mpi_tpu.finalize()
+            return got
+
+        out = spmd(main, n=4)
+        assert out[0] is None  # left edge: no left neighbor
+        assert [out[r] for r in range(1, 4)] == [0, 1, 2]
+
+    def test_bad_dims_rejected(self):
+        def main():
+            mpi_tpu.init()
+            try:
+                w = comm_world()
+                before = w._impl._comm_ctx_high \
+                    if hasattr(w._impl, "_comm_ctx_high") else 0
+                with pytest.raises(mpi_tpu.MpiError, match="cover"):
+                    mpi_tpu.cart_create(w, (3, 2))
+                # Shape rejected BEFORE the collective split: no context
+                # was negotiated (and no rank is stuck in an allgather).
+                after = getattr(w._impl, "_comm_ctx_high", 0)
+                assert after == before
+                cart = mpi_tpu.cart_create(w, (2, 2))
+                with pytest.raises(mpi_tpu.MpiError, match="out of range"):
+                    cart.rank_of((2, 0))
+            finally:
+                mpi_tpu.finalize()
+
+        spmd(main, n=4)
+
+
 class TestTcpDriver:
     def test_split_and_group_traffic_over_tcp(self):
         with tcp_cluster(4) as nets:
