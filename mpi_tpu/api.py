@@ -35,7 +35,10 @@ back to generic tree/ring algorithms built on ``send``/``receive``
 from __future__ import annotations
 
 import threading
-from typing import Any, List, Optional, Protocol, runtime_checkable
+from typing import TYPE_CHECKING, Any, List, Optional, Protocol, runtime_checkable
+
+if TYPE_CHECKING:
+    from .collectives_generic import OpLike
 
 from .utils.serialize import Raw
 
@@ -369,19 +372,19 @@ def _collective(name: str, *args: Any, **kwargs: Any) -> Any:
         return call()
 
 
-def allreduce(data: Any, op: str = "sum") -> Any:
+def allreduce(data: Any, op: "OpLike" = "sum") -> Any:
     """Combine ``data`` across all ranks with ``op`` and return the result
     on every rank. ops: sum, prod, min, max. The north-star collective
     (BASELINE.json north_star)."""
     return _collective("allreduce", data, op=op)
 
 
-def reduce(data: Any, root: int = 0, op: str = "sum") -> Optional[Any]:
+def reduce(data: Any, root: int = 0, op: "OpLike" = "sum") -> Optional[Any]:
     """Combine across ranks; result only on ``root`` (None elsewhere)."""
     return _collective("reduce", data, root=root, op=op)
 
 
-def reduce_scatter(data: Any, op: str = "sum") -> Any:
+def reduce_scatter(data: Any, op: "OpLike" = "sum") -> Any:
     """Combine ``data`` across ranks, then return only this rank's block:
     the leading axis splits into ``size`` equal blocks and rank ``i``
     gets reduced block ``i`` — the bandwidth-optimal half of ring
@@ -511,13 +514,13 @@ def waitall(requests: List[Request],
     return results
 
 
-def scan(data: Any, op: str = "sum") -> Any:
+def scan(data: Any, op: "OpLike" = "sum") -> Any:
     """Inclusive prefix reduction in rank order: rank r gets the
     combination of ranks 0..r (MPI_Scan)."""
     return _collective("scan", data, op=op)
 
 
-def exscan(data: Any, op: str = "sum") -> Optional[Any]:
+def exscan(data: Any, op: "OpLike" = "sum") -> Optional[Any]:
     """Exclusive prefix reduction: rank r gets ranks 0..r-1 combined;
     rank 0 gets None (MPI_Exscan)."""
     return _collective("exscan", data, op=op)

@@ -35,7 +35,10 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Any, Callable, List, Optional, Sequence
+from typing import TYPE_CHECKING, Any, Callable, List, Optional, Sequence
+
+if TYPE_CHECKING:
+    from ..collectives_generic import OpLike
 
 from .. import collectives_generic as G
 from ..api import MpiError
@@ -272,13 +275,13 @@ class HybridNetwork:
                 self._world_eng = eng
             return eng
 
-    def allreduce(self, data: Any, op: str = "sum") -> Any:
+    def allreduce(self, data: Any, op: "OpLike" = "sum") -> Any:
         return self._world_engine().allreduce(data, op=op)
 
-    def reduce(self, data: Any, root: int = 0, op: str = "sum") -> Optional[Any]:
+    def reduce(self, data: Any, root: int = 0, op: "OpLike" = "sum") -> Optional[Any]:
         return self._world_engine().reduce(data, root=root, op=op)
 
-    def reduce_scatter(self, data: Any, op: str = "sum") -> Any:
+    def reduce_scatter(self, data: Any, op: "OpLike" = "sum") -> Any:
         return self._world_engine().reduce_scatter(data, op=op)
 
     def barrier(self) -> None:
@@ -419,13 +422,21 @@ class _HybridGroupEngine:
 
     # -- collectives -------------------------------------------------------
 
-    def allreduce(self, data: Any, op: str = "sum") -> Any:
+    def allreduce(self, data: Any, op="sum") -> Any:
         G.check_op(op)
+        if callable(op):
+            # User callables promise associativity only — the
+            # hierarchical local-then-host fold would reorder operands
+            # whenever group order interleaves hosts, silently breaking
+            # non-commutative ops. allgather is group-rank-ordered, so
+            # fold it in the canonical tree instead (same order as every
+            # other driver).
+            return G.tree_combine(self.allgather(data), op)
         local_total = self._inner.allreduce(data, op=op)
         return self._leader_leg(
             local_total, lambda t: G.allreduce(self._tcp_grp, t, op=op))
 
-    def reduce(self, data: Any, root: int = 0, op: str = "sum"
+    def reduce(self, data: Any, root: int = 0, op: "OpLike" = "sum"
                ) -> Optional[Any]:
         result = self.allreduce(data, op=op)
         me = self._members.index(self._net.rank())
@@ -472,7 +483,7 @@ class _HybridGroupEngine:
         me = self._members.index(self._net.rank())
         return result if me == root else None
 
-    def reduce_scatter(self, data: Any, op: str = "sum") -> Any:
+    def reduce_scatter(self, data: Any, op: "OpLike" = "sum") -> Any:
         """Hierarchical allreduce, then keep this group rank's block."""
         import numpy as _np
 

@@ -37,7 +37,10 @@ from __future__ import annotations
 import copy
 import threading
 from collections import OrderedDict
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+if TYPE_CHECKING:
+    from ..collectives_generic import OpLike
 
 import numpy as np
 
@@ -342,7 +345,7 @@ class _MeshCollectives:
             return None
         return np_slots
 
-    def allreduce(self, data: Any, op: str = "sum",
+    def allreduce(self, data: Any, op: "OpLike" = "sum",
                   deterministic: Optional[bool] = None) -> Any:
         """North-star collective: one XLA reduction over the mesh.
 
@@ -361,8 +364,10 @@ class _MeshCollectives:
                     f"dtype {np_slots[0].dtype}")
             scalar = np_slots[0].ndim == 0
             self._validate_payloads(np_slots)
-            if self._mesh is None:
-                # Oversubscribed ranks share devices → no mesh; reduce on
+            if self._mesh is None or callable(op):
+                # Oversubscribed ranks share devices → no mesh; user
+                # callable ops (MPI_Op_create analogue) are host
+                # functions XLA cannot compile. Either way reduce on
                 # the host in the canonical binomial-tree order (always
                 # deterministic, bitwise-equal to the TCP oracle).
                 from ..collectives_generic import tree_combine
@@ -515,12 +520,12 @@ class _MeshCollectives:
 
         return self._coll.run(self._myrank(), data, leader)
 
-    def reduce(self, data: Any, root: int = 0, op: str = "sum") -> Optional[Any]:
+    def reduce(self, data: Any, root: int = 0, op: "OpLike" = "sum") -> Optional[Any]:
         self._check_rank(root)
         result = self.allreduce(data, op=op)
         return result if self._myrank() == root else None
 
-    def reduce_scatter(self, data: Any, op: str = "sum",
+    def reduce_scatter(self, data: Any, op: "OpLike" = "sum",
                        deterministic: Optional[bool] = None) -> Any:
         """Reduce across ranks and keep this rank's block of the result:
         the payload's leading axis splits into ``size`` equal blocks and
@@ -543,7 +548,7 @@ class _MeshCollectives:
                     f"{shape or 'scalar'} must divide into {self._n} "
                     f"equal blocks")
             m = shape[0] // self._n
-            if self._mesh is None:
+            if self._mesh is None or callable(op):
                 total = tree_combine(np_slots, op)
                 return [total[i * m:(i + 1) * m].copy()
                         for i in range(self._n)]
@@ -741,7 +746,7 @@ class XlaNetwork:
 
     # -- native collectives (world engine; see _MeshCollectives) -------------
 
-    def allreduce(self, data: Any, op: str = "sum",
+    def allreduce(self, data: Any, op: "OpLike" = "sum",
                   deterministic: Optional[bool] = None) -> Any:
         return self._world_coll.allreduce(data, op=op,
                                           deterministic=deterministic)
@@ -765,10 +770,10 @@ class XlaNetwork:
         return self._world_coll.alltoall(data)
 
     def reduce(self, data: Any, root: int = 0,
-               op: str = "sum") -> Optional[Any]:
+               op: "OpLike" = "sum") -> Optional[Any]:
         return self._world_coll.reduce(data, root=root, op=op)
 
-    def reduce_scatter(self, data: Any, op: str = "sum",
+    def reduce_scatter(self, data: Any, op: "OpLike" = "sum",
                        deterministic: Optional[bool] = None) -> Any:
         return self._world_coll.reduce_scatter(data, op=op,
                                                deterministic=deterministic)

@@ -26,7 +26,7 @@ so collective traffic can never collide with user point-to-point tags).
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Union
 
 import numpy as np
 
@@ -35,6 +35,7 @@ from .api import exchange as _sendrecv  # shared concurrent-exchange engine
 
 __all__ = [
     "COLL_TAG_BASE",
+    "OpLike",
     "combine",
     "tree_combine",
     "reduce",
@@ -49,6 +50,10 @@ __all__ = [
     "exscan",
     "barrier",
 ]
+
+# A reduction op: a built-in name or an associative user callable
+# (the MPI_Op_create analogue; see check_op).
+OpLike = Union[str, Callable[[Any, Any], Any]]
 
 # User tags live below this; collective rounds allocate from above it.
 COLL_TAG_BASE = 1 << 48
@@ -78,25 +83,36 @@ _OPS: dict[str, Callable[[np.ndarray, np.ndarray], np.ndarray]] = {
 }
 
 
-def check_op(op: str) -> None:
-    """Validate a reduction op name. Called on *every* rank before any
-    communication so a bad op fails everywhere instead of deadlocking the
-    ranks whose partner errored."""
+def check_op(op) -> None:
+    """Validate a reduction op — a built-in name or a user callable (the
+    MPI_Op_create analogue: ``op(a, b) -> combined``, associative; the
+    canonical binomial tree preserves rank order, so non-commutative
+    ops are well-defined). Called on *every* rank before any
+    communication so a bad op fails everywhere instead of deadlocking
+    the ranks whose partner errored."""
+    if callable(op):
+        return
     if op not in _OPS:
         raise MpiError(f"mpi_tpu: unknown reduction op {op!r}; "
-                       f"expected one of {sorted(_OPS)}")
+                       f"expected one of {sorted(_OPS)} or a callable "
+                       f"op(a, b) -> combined")
 
 
-def combine(a: Any, b: Any, op: str) -> Any:
+def combine(a: Any, b: Any, op) -> Any:
     """``op(a, b)`` elementwise, preserving dtype. Shared by every backend
-    so the arithmetic (not just the order) is identical across drivers."""
+    so the arithmetic (not just the order) is identical across drivers.
+    ``op`` may be a built-in name or a user callable (check_op)."""
     check_op(op)
-    fn = _OPS[op]
+    fn = op if callable(op) else _OPS[op]
     an, bn = np.asarray(a), np.asarray(b)
     if an.shape != bn.shape:
         raise MpiError(
             f"mpi_tpu: reduction shape mismatch across ranks: {an.shape} vs {bn.shape}")
-    out = fn(an, bn)
+    out = np.asarray(fn(an, bn))
+    if out.shape != an.shape:
+        raise MpiError(
+            f"mpi_tpu: user reduction op changed the payload shape: "
+            f"{an.shape} -> {out.shape}")
     if np.isscalar(a) or an.ndim == 0:
         return out[()] if isinstance(out, np.ndarray) else out
     return out
@@ -104,7 +120,7 @@ def combine(a: Any, b: Any, op: str) -> Any:
 
 
 
-def tree_combine(slots: List[Any], op: str) -> np.ndarray:
+def tree_combine(slots: List[Any], op: OpLike) -> np.ndarray:
     """Fold ``slots`` (rank-ordered payloads) in the canonical binomial-tree
     order — the single host-side definition of the combination order that
     ``reduce`` executes over the wire, ``parallel.collectives.
@@ -122,7 +138,7 @@ def tree_combine(slots: List[Any], op: str) -> np.ndarray:
     return acc[0]
 
 
-def reduce(impl: Interface, data: Any, root: int = 0, op: str = "sum",
+def reduce(impl: Interface, data: Any, root: int = 0, op: OpLike = "sum",
            _tag_base: Optional[int] = None) -> Optional[Any]:
     """Binomial-tree reduce in the canonical order; result on ``root``.
 
@@ -180,7 +196,7 @@ def bcast(impl: Interface, data: Any, root: int = 0,
     return payload
 
 
-def allreduce(impl: Interface, data: Any, op: str = "sum") -> Any:
+def allreduce(impl: Interface, data: Any, op: OpLike = "sum") -> Any:
     """reduce-to-0 + bcast, preserving the canonical combination order.
 
     A ring reduce-scatter+allgather would move less data for large buffers,
@@ -192,7 +208,7 @@ def allreduce(impl: Interface, data: Any, op: str = "sum") -> Any:
     return bcast(impl, result, root=0, _tag_base=tag + 64)
 
 
-def reduce_scatter(impl: Interface, data: Any, op: str = "sum") -> Any:
+def reduce_scatter(impl: Interface, data: Any, op: OpLike = "sum") -> Any:
     """Reduce across ranks, then keep this rank's block: the payload's
     leading axis splits into ``size`` equal blocks and rank ``i`` returns
     reduced block ``i``. Combination order is the canonical binomial tree
@@ -316,7 +332,7 @@ def _allgather_best(impl: Interface, data: Any) -> List[Any]:
     return native(data) if native is not None else allgather(impl, data)
 
 
-def _prefix_fold(items: List[Any], count: int, op: str) -> Any:
+def _prefix_fold(items: List[Any], count: int, op: OpLike) -> Any:
     """Left fold of ``items[:count]`` in rank order — the combination
     order shared by scan/exscan here and ``parallel.collectives.
     prefix_reduce`` (bitwise contract across backends)."""
@@ -326,7 +342,7 @@ def _prefix_fold(items: List[Any], count: int, op: str) -> Any:
     return acc
 
 
-def scan(impl: Interface, data: Any, op: str = "sum") -> Any:
+def scan(impl: Interface, data: Any, op: OpLike = "sum") -> Any:
     """Inclusive prefix reduction: rank ``r`` returns
     ``data_0 op data_1 op ... op data_r``, combined in rank order
     (deterministic — the order IS the contract, like the binomial tree
@@ -339,7 +355,7 @@ def scan(impl: Interface, data: Any, op: str = "sum") -> Any:
     return _prefix_fold(items, impl.rank() + 1, op)
 
 
-def exscan(impl: Interface, data: Any, op: str = "sum") -> Optional[Any]:
+def exscan(impl: Interface, data: Any, op: OpLike = "sum") -> Optional[Any]:
     """Exclusive prefix reduction: rank ``r`` returns the combination of
     ranks ``0..r-1``; rank 0 returns ``None`` (MPI_Exscan leaves its
     buffer undefined there — None makes that explicit)."""

@@ -46,7 +46,10 @@ Design (tpu-first, but transport-agnostic):
 from __future__ import annotations
 
 import threading
-from typing import Any, List, Optional, Tuple
+from typing import TYPE_CHECKING, Any, List, Optional, Tuple
+
+if TYPE_CHECKING:
+    from .collectives_generic import OpLike
 
 from .api import Interface, MpiError, Request, exchange as _exchange
 
@@ -372,13 +375,13 @@ class Comm:
                 return native(*args, **kwargs)
         return getattr(gen, name)(self, *args, **kwargs)
 
-    def allreduce(self, data: Any, op: str = "sum") -> Any:
+    def allreduce(self, data: Any, op: "OpLike" = "sum") -> Any:
         return self._coll("allreduce", data, op=op)
 
-    def reduce(self, data: Any, root: int = 0, op: str = "sum") -> Optional[Any]:
+    def reduce(self, data: Any, root: int = 0, op: "OpLike" = "sum") -> Optional[Any]:
         return self._coll("reduce", data, root=root, op=op)
 
-    def reduce_scatter(self, data: Any, op: str = "sum") -> Any:
+    def reduce_scatter(self, data: Any, op: "OpLike" = "sum") -> Any:
         return self._coll("reduce_scatter", data, op=op)
 
     def bcast(self, data: Any, root: int = 0) -> Any:
@@ -396,10 +399,10 @@ class Comm:
     def alltoall(self, data: List[Any]) -> List[Any]:
         return self._coll("alltoall", data)
 
-    def scan(self, data: Any, op: str = "sum") -> Any:
+    def scan(self, data: Any, op: "OpLike" = "sum") -> Any:
         return self._coll("scan", data, op=op)
 
-    def exscan(self, data: Any, op: str = "sum") -> Optional[Any]:
+    def exscan(self, data: Any, op: "OpLike" = "sum") -> Optional[Any]:
         return self._coll("exscan", data, op=op)
 
     def barrier(self) -> None:

@@ -70,6 +70,34 @@ class TestAllreduce:
             run_on_ranks(anycluster,
                          lambda net, r: gen.allreduce(net, 1.0, op="xor"))
 
+    def test_user_callable_op_matmul(self, anycluster):
+        """MPI_Op_create analogue: a user callable — here matrix
+        multiplication, associative but NON-commutative — reduces in
+        rank order (the binomial tree preserves operand order), so the
+        result is the ordered product A0 @ A1 @ ... @ An-1 exactly."""
+        n = len(anycluster)
+        mats = [np.array([[1.0, float(r + 1)], [0.0, 1.0]])
+                for r in range(n)]  # upper-triangular: exact products
+        expect = mats[0]
+        for m in mats[1:]:
+            expect = expect @ m
+        op = lambda a, b: a @ b  # noqa: E731
+        out = run_on_ranks(anycluster,
+                           lambda net, r: gen.allreduce(net, mats[r], op=op))
+        for o in out:
+            np.testing.assert_array_equal(o, expect)
+
+    def test_user_op_shape_change_rejected(self):
+        # Guard unit-tested at the combine level: in a live collective it
+        # raises on whichever rank performs the bad combine (a buggy user
+        # op mid-collective is undefined behavior in MPI terms — the
+        # guard turns silent corruption into a loud error there).
+        from mpi_tpu.api import MpiError
+
+        bad = lambda a, b: np.concatenate([a, b])  # noqa: E731
+        with pytest.raises(MpiError, match="changed the payload shape"):
+            gen.combine(np.ones(3), np.ones(3), bad)
+
 
 class TestReduceBcast:
     @pytest.mark.parametrize("root", [0, 1])

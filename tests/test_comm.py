@@ -446,6 +446,37 @@ class TestGroupOps:
         run_spmd(lambda: main(), net=net)
         assert len(net._group_colls) == 3
 
+    def test_user_callable_op_in_group_and_world(self):
+        """Callable reduction ops (MPI_Op_create analogue) work through
+        the facade, the xla engines (host binomial tree — XLA cannot
+        compile a Python callable), and group engines; matmul's
+        non-commutativity proves rank order is preserved."""
+        mats = [np.array([[1.0, float(r + 1)], [0.0, 1.0]], np.float64)
+                for r in range(N)]
+        op = lambda a, b: a @ b  # noqa: E731
+
+        def ordered(ms):
+            acc = ms[0]
+            for m in ms[1:]:
+                acc = acc @ m
+            return acc
+
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            world = mpi_tpu.allreduce(mats[r], op=op)
+            sub = comm_world().split(color=r % 2)
+            group = sub.allreduce(mats[r], op=op)
+            mpi_tpu.finalize()
+            return np.asarray(world), np.asarray(group)
+
+        out = spmd(main)
+        for r in range(N):
+            np.testing.assert_array_equal(out[r][0], ordered(mats))
+            members = list(range(r % 2, N, 2))
+            np.testing.assert_array_equal(
+                out[r][1], ordered([mats[m] for m in members]))
+
     def test_group_sendrecv_ring(self):
         def main():
             mpi_tpu.init()

@@ -294,6 +294,38 @@ def test_cross_host_group_collectives_hierarchical():
     # the cross-host results above matching the hierarchical layout.)
 
 
+def test_callable_op_rank_order_across_hosts():
+    """Non-commutative callable op (matmul) on a host-INTERLEAVED group:
+    the hierarchical local-then-host fold would reorder operands, so the
+    engine must fall back to the group-rank-ordered tree."""
+    from mpi_tpu.comm import comm_world
+
+    mats = [np.array([[1.0, float(r + 1)], [0.0, 1.0]]) for r in range(4)]
+
+    def fn_for(net):
+        def main():
+            net.init()
+            w = comm_world(net)
+            r = w.rank()
+            # key=-r reverses group order: members (2, 0) / (3, 1) —
+            # interleaving hosts relative to rank order.
+            sub = w.split(color=r % 2, key=-r)
+            out = sub.allreduce(mats[r], op=lambda a, b: a @ b)
+            wout = net.allreduce(mats[r], op=lambda a, b: a @ b)
+            net.finalize()
+            return np.asarray(out), np.asarray(wout)
+
+        return main
+
+    out = run_world(fn_for)
+    world_expect = mats[0] @ mats[1] @ mats[2] @ mats[3]
+    for r in range(4):
+        members = (2, 0) if r % 2 == 0 else (3, 1)
+        expect = mats[members[0]] @ mats[members[1]]
+        np.testing.assert_array_equal(out[r][0], expect)
+        np.testing.assert_array_equal(out[r][1], world_expect)
+
+
 def test_cross_host_group_p2p_raises_clearly():
     from mpi_tpu.comm import comm_world
 
