@@ -374,8 +374,11 @@ def _collective(name: str, *args: Any, **kwargs: Any) -> Any:
 
 def allreduce(data: Any, op: "OpLike" = "sum") -> Any:
     """Combine ``data`` across all ranks with ``op`` and return the result
-    on every rank. ops: sum, prod, min, max. The north-star collective
-    (BASELINE.json north_star)."""
+    on every rank. ``op``: "sum"/"prod"/"min"/"max", or any associative
+    callable ``op(a, b) -> combined`` (the MPI_Op_create analogue —
+    combination strictly in rank order, so non-commutative ops are
+    well-defined; callables reduce on the host tree since XLA cannot
+    compile them). The north-star collective (BASELINE.json)."""
     return _collective("allreduce", data, op=op)
 
 

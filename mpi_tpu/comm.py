@@ -58,6 +58,11 @@ __all__ = ["Comm", "CartComm", "cart_create", "comm_world", "CTX_SPAN",
 
 CTX_SPAN = 1 << 44        # tag-space region per context
 USER_TAG_SPAN = 1 << 40   # user tags within a region: [0, 2^40)
+# CartComm neighborhood collectives own the TOP slice of each context's
+# collective offset space — outside the user tag range entirely (no
+# user tag can alias a halo message) and fenced off from the generic
+# collectives' growing sequence by _map_tag's exhaustion check.
+_NEIGHBOR_SLICE = 1 << 20
 
 _ctx_lock = threading.Lock()
 
@@ -277,6 +282,10 @@ class Comm:
         if 0 <= tag < USER_TAG_SPAN:
             offset = tag
         elif tag >= COLL_TAG_BASE:
+            # Generic collective rounds (allocated below the neighbor
+            # slice — the _coll_seq setter enforces that) and synthetic
+            # neighborhood tags (constructed inside the slice) share
+            # this arithmetic.
             offset = USER_TAG_SPAN + (tag - COLL_TAG_BASE)
             if offset >= CTX_SPAN:
                 raise MpiError(
@@ -323,6 +332,16 @@ class Comm:
 
     @_coll_seq.setter
     def _coll_seq(self, value: int) -> None:
+        from .collectives_generic import _TAGS_PER_COLLECTIVE
+
+        # Cap the generic sequence below the neighborhood slice at the
+        # top of the collective offset space: allocation-time exhaustion
+        # beats a silently mis-routed halo tag ~4e9 collectives later.
+        limit = (CTX_SPAN - USER_TAG_SPAN - _NEIGHBOR_SLICE) \
+            // _TAGS_PER_COLLECTIVE
+        if value >= limit:
+            raise MpiError(
+                "mpi_tpu: communicator collective tag space exhausted")
         self._coll_state()._coll_seq = value
 
     # -- collectives -------------------------------------------------------
@@ -562,6 +581,80 @@ class CartComm(Comm):
             return self.rank_of(trial)
 
         return at(-disp), at(disp)
+
+    def neighbors(self) -> List[Optional[int]]:
+        """This rank's grid neighbors in MPI neighborhood-collective
+        order: for each axis, the -1 then the +1 displacement
+        (``[axis0-, axis0+, axis1-, axis1+, ...]``), ``None`` at
+        non-periodic edges (PROC_NULL)."""
+        out: List[Optional[int]] = []
+        for ax in range(len(self._dims)):
+            src, dst = self.shift(ax, 1)
+            out.extend((src, dst))
+        return out
+
+    def _neighbor_tag(self, tag: int, slot: int) -> int:
+        """Synthetic tag inside the reserved neighborhood slice at the
+        top of this context's collective offset space — no user tag can
+        reach it, and the generic collectives' sequence is capped below
+        it (the _coll_seq setter)."""
+        from .collectives_generic import COLL_TAG_BASE
+
+        if not 0 <= tag < (1 << 13):
+            raise MpiError(
+                f"mpi_tpu: neighbor collective tag must be in [0, 8192), "
+                f"got {tag}")
+        assert slot < 64
+        return COLL_TAG_BASE + (CTX_SPAN - USER_TAG_SPAN
+                                - _NEIGHBOR_SLICE) + tag * 64 + slot
+
+    def neighbor_allgather(self, data: Any, tag: int = 0
+                           ) -> List[Optional[Any]]:
+        """Exchange ``data`` with every grid neighbor
+        (MPI_Neighbor_allgather over the Cartesian topology): returns one
+        payload per :meth:`neighbors` slot, ``None`` where the neighbor
+        is PROC_NULL — the bulk-synchronous halo exchange, spelled once
+        for any rank count and dimensionality. Exactly
+        :meth:`neighbor_alltoall` with the same payload in every slot."""
+        return self.neighbor_alltoall(
+            [data] * (2 * len(self._dims)), tag=tag)
+
+    def neighbor_alltoall(self, data: List[Any], tag: int = 0
+                          ) -> List[Optional[Any]]:
+        """Per-neighbor payloads (MPI_Neighbor_alltoall): ``data[i]``
+        goes to ``neighbors()[i]``; returns what each neighbor sent this
+        rank, ``None`` for PROC_NULL slots. Slot pairing follows MPI:
+        what arrives in the ``axis-`` slot is what the minus-neighbor
+        sent through its ``axis+`` slot, and vice versa. All exchanges
+        for all axes run concurrently (one Request per direction)."""
+        nbrs = self.neighbors()
+        if len(data) != len(nbrs):
+            raise MpiError(
+                f"mpi_tpu: neighbor_alltoall needs {len(nbrs)} payloads "
+                f"(2 per axis), got {len(data)}")
+        if len(self._dims) > 15:
+            raise MpiError(
+                "mpi_tpu: neighborhood collectives support at most 15 "
+                "grid axes (tag slot budget)")
+        reqs: List[Request] = []
+        for ax in range(len(self._dims)):
+            src, dst = self.shift(ax, 1)
+            lo_idx, hi_idx = ax * 2, ax * 2 + 1
+            # Slot i is received FROM neighbor i and data[i] is sent TO
+            # neighbor i. Payloads moving in the + direction (my hi-slot
+            # payload to dst) arrive as the receiver's lo slot, so each
+            # exchange pairs (send data[hi] to dst, receive lo from src)
+            # and vice versa; distinct tags keep the two directions
+            # unmixable when src == dst (a 2-wide periodic axis).
+            reqs.append(Request(
+                lambda d=data[hi_idx], s=src, t=dst,
+                g=self._neighbor_tag(tag, ax * 2):
+                self.sendrecv(d, dest=t, source=s, tag=g)))
+            reqs.append(Request(
+                lambda d=data[lo_idx], s=dst, t=src,
+                g=self._neighbor_tag(tag, ax * 2 + 1):
+                self.sendrecv(d, dest=t, source=s, tag=g)))
+        return [r.wait(timeout=None) for r in reqs]
 
     def sub(self, keep) -> "CartComm":
         """Slice the grid (MPI_Cart_sub): ranks sharing coordinates on

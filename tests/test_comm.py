@@ -573,6 +573,59 @@ class TestCartesian:
         for r in range(8):
             assert out[r] == ("halo", (r - 1) % 8)
 
+    def test_neighbor_allgather_2d(self):
+        """4-neighbor halo on a 2x4 grid, periodic columns only: every
+        rank learns each neighbor's rank, None at the row edges."""
+        def main():
+            mpi_tpu.init()
+            cart = mpi_tpu.cart_create(comm_world(), (2, 4),
+                                       periods=(False, True))
+            got = cart.neighbor_allgather(cart.rank())
+            mpi_tpu.finalize()
+            return got, cart.neighbors()
+
+        out = spmd(main)
+        for r in range(8):
+            got, nbrs = out[r]
+            assert got == nbrs  # each slot carries that neighbor's rank
+            row, col = divmod(r, 4)
+            assert nbrs == [
+                None if row == 0 else r - 4,     # axis0 -
+                None if row == 1 else r + 4,     # axis0 +
+                row * 4 + (col - 1) % 4,         # axis1 - (periodic)
+                row * 4 + (col + 1) % 4,         # axis1 +
+            ]
+
+    def test_neighbor_alltoall_directional(self):
+        """Per-neighbor payloads land in the matching slot: what arrives
+        from the minus neighbor is what it addressed to its plus slot."""
+        def main():
+            mpi_tpu.init()
+            cart = mpi_tpu.cart_create(comm_world(), (8,), periods=(True,))
+            r = cart.rank()
+            sends = [("to-minus", r), ("to-plus", r)]
+            got = cart.neighbor_alltoall(sends)
+            mpi_tpu.finalize()
+            return got
+
+        out = spmd(main)
+        for r in range(8):
+            lo, hi = out[r]
+            assert tuple(lo) == ("to-plus", (r - 1) % 8)
+            assert tuple(hi) == ("to-minus", (r + 1) % 8)
+
+    def test_neighbor_alltoall_wrong_length(self):
+        def main():
+            mpi_tpu.init()
+            try:
+                cart = mpi_tpu.cart_create(comm_world(), (2, 2))
+                with pytest.raises(mpi_tpu.MpiError, match="payloads"):
+                    cart.neighbor_alltoall([1, 2, 3])
+            finally:
+                mpi_tpu.finalize()
+
+        spmd(main, n=4)
+
     def test_halo_exchange_nonperiodic_proc_null(self):
         """Edge ranks get None (PROC_NULL) from shift; p2p treats it as
         a no-op leg, so the same halo loop works at the boundary: the
