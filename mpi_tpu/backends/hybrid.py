@@ -75,6 +75,12 @@ class HybridNetwork:
     (constructor args or ``--mpi-*`` flags, same ABI as TcpNetwork) and the
     local rank count; run rank threads with :func:`run_spmd_hybrid`."""
 
+    # Communicator (context-region) tags cannot cross hosts — the
+    # composed wire tag has no room for a context (_compose_tag).
+    # mpi_tpu.comm checks this to route neighborhood collectives through
+    # the hierarchical group allgather instead of pairwise sendrecv.
+    SUPPORTS_COMM_CROSS_HOST_P2P = False
+
     def __init__(self, local_ranks: Optional[int] = None,
                  devices: Optional[Sequence[Any]] = None,
                  oversubscribe: bool = True,

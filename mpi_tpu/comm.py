@@ -636,6 +636,24 @@ class CartComm(Comm):
             raise MpiError(
                 "mpi_tpu: neighborhood collectives support at most 15 "
                 "grid axes (tag slot budget)")
+        if not getattr(self._impl, "SUPPORTS_COMM_CROSS_HOST_P2P", True):
+            # The hybrid driver cannot carry communicator p2p between
+            # hosts (the composed cross-host tag has no room for a
+            # context), so pairwise halo sendrecv would deadlock on any
+            # host-spanning grid. Its group allgather IS hierarchical
+            # (compiled local + one TCP leg), so exchange everything
+            # and pick this rank's slots: slot i receives what neighbor
+            # i addressed to its OPPOSITE slot.
+            all_sends = self.allgather(list(data))
+            out: List[Optional[Any]] = []
+            for ax in range(len(self._dims)):
+                src, dst = self.shift(ax, 1)
+                lo_idx, hi_idx = ax * 2, ax * 2 + 1
+                out.append(None if src is None
+                           else all_sends[src][hi_idx])
+                out.append(None if dst is None
+                           else all_sends[dst][lo_idx])
+            return out
         reqs: List[Request] = []
         for ax in range(len(self._dims)):
             src, dst = self.shift(ax, 1)

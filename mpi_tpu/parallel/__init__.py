@@ -26,6 +26,7 @@ from .ring_attention import (
     zigzag_indices,
     zigzag_inverse_indices,
 )
+from .halo import halo_exchange, jacobi_step_1d
 from .pipeline import pipeline, pipeline_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 
@@ -40,6 +41,8 @@ __all__ = [
     "ring_attention_zigzag",
     "zigzag_indices",
     "zigzag_inverse_indices",
+    "halo_exchange",
+    "jacobi_step_1d",
     "pipeline",
     "pipeline_sharded",
     "ulysses_attention",

@@ -1,0 +1,90 @@
+"""Compiled halo exchange for spatially-sharded arrays — stencil support.
+
+The tpu-native counterpart of :meth:`mpi_tpu.comm.CartComm`'s
+neighborhood collectives: where the host-side layer moves halos between
+rank processes with tagged sendrecv, this one runs INSIDE a jitted
+``shard_map`` program — each device's block fetches ``width`` boundary
+slices from its mesh-axis neighbors with two ``lax.ppermute`` hops (pure
+ICI traffic on TPU) and concatenates them, so a stencil step (Jacobi,
+convolution, finite differences) over a sharded grid is one compiled
+program with no host involvement. No reference analogue (btracey/mpi
+has no arrays at all); the pattern every MPI stencil code hand-rolls is
+here a single call.
+
+Layout contract: the global array's ``dim`` axis is sharded over
+``axis_name`` in mesh order (block i on axis position i) — exactly what
+``P(axis_name)`` sharding produces. Non-periodic edges receive
+``fill_value`` halos (XLA's ppermute already yields zeros for ranks
+outside the permutation; non-zero fills are patched in at the edge
+devices only).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import RANK_AXIS
+
+__all__ = ["halo_exchange", "jacobi_step_1d"]
+
+
+def halo_exchange(x: jnp.ndarray, width: int = 1, dim: int = 0,
+                  axis_name: str = RANK_AXIS, periodic: bool = False,
+                  fill_value: float = 0.0) -> jnp.ndarray:
+    """Pad this device's block with its neighbors' boundary slices.
+
+    ``x`` is the local block of a ``dim``-sharded global array; returns
+    the block extended to ``shape[dim] + 2 * width``: ``width`` rows
+    from the minus neighbor, the block, ``width`` rows from the plus
+    neighbor. ``periodic`` wraps the ends; otherwise the outermost
+    devices get ``fill_value`` halos. Must be traced inside
+    ``shard_map`` over ``axis_name``.
+    """
+    if width < 1:
+        raise ValueError(f"mpi_tpu: halo width must be >= 1, got {width}")
+    if x.shape[dim] < width:
+        raise ValueError(
+            f"mpi_tpu: block extent {x.shape[dim]} on dim {dim} is "
+            f"smaller than halo width {width}")
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+
+    # Boundary slices: my high edge feeds the plus neighbor's low halo,
+    # my low edge feeds the minus neighbor's high halo.
+    hi_edge = lax.slice_in_dim(x, x.shape[dim] - width, x.shape[dim],
+                               axis=dim)
+    lo_edge = lax.slice_in_dim(x, 0, width, axis=dim)
+
+    if periodic:
+        fwd = [(r, (r + 1) % n) for r in range(n)]
+        bwd = [(r, (r - 1) % n) for r in range(n)]
+    else:
+        fwd = [(r, r + 1) for r in range(n - 1)]
+        bwd = [(r, r - 1) for r in range(1, n)]
+    from_minus = lax.ppermute(hi_edge, axis_name, fwd)
+    from_plus = lax.ppermute(lo_edge, axis_name, bwd)
+
+    if not periodic and fill_value != 0.0:
+        # ppermute leaves zeros on ranks outside the pattern; replace
+        # with the requested fill on the edge devices only.
+        fill = jnp.full_like(from_minus, fill_value)
+        from_minus = jnp.where(idx == 0, fill, from_minus)
+        from_plus = jnp.where(idx == n - 1, fill, from_plus)
+    return jnp.concatenate([from_minus, x, from_plus], axis=dim)
+
+
+def jacobi_step_1d(u: jnp.ndarray, axis_name: str = RANK_AXIS,
+                   periodic: bool = False,
+                   boundary: Optional[float] = 0.0) -> jnp.ndarray:
+    """One 1-D Jacobi relaxation sweep over a sharded line:
+    ``u[i] <- (u[i-1] + u[i+1]) / 2`` with halo exchange supplying the
+    cross-device neighbors — the canonical stencil demo (and the shape
+    of any 3-point finite-difference update). ``boundary`` is the fixed
+    Dirichlet value outside a non-periodic domain."""
+    padded = halo_exchange(u, width=1, axis_name=axis_name,
+                           periodic=periodic,
+                           fill_value=0.0 if boundary is None else boundary)
+    return (padded[:-2] + padded[2:]) * 0.5

@@ -44,6 +44,16 @@ class TestBounce:
 
 
 @pytest.mark.integration
+class TestStencil:
+    def test_host_jacobi_4_ranks(self):
+        res = _mpirun(4, "examples/stencil.py")
+        assert res.returncode == 0, res.stderr
+        assert "host Jacobi ok: 4 ranks" in res.stdout
+        # The example exits nonzero on any mismatch vs the dense
+        # reference, so success == bitwise-verified halos.
+
+
+@pytest.mark.integration
 class TestCommGroups:
     def test_2x2_grid(self):
         res = _mpirun(4, "examples/comm_groups.py")

@@ -326,6 +326,38 @@ def test_callable_op_rank_order_across_hosts():
         np.testing.assert_array_equal(out[r][1], world_expect)
 
 
+def test_neighbor_collectives_cross_host_via_allgather():
+    """A Cartesian grid spanning both hosts: neighborhood collectives
+    must route through the hierarchical group allgather (pairwise comm
+    sendrecv cannot cross hosts on the hybrid driver and would hang)."""
+    from mpi_tpu.comm import comm_world
+
+    def fn_for(net):
+        def main():
+            net.init()
+            w = comm_world(net)
+            cart = mpi_tpu_cart(w)
+            halo = cart.neighbor_allgather(cart.rank())
+            a2a = cart.neighbor_alltoall(
+                [("m", cart.rank()), ("p", cart.rank())])
+            net.finalize()
+            return halo, a2a
+
+        return main
+
+    import mpi_tpu
+
+    def mpi_tpu_cart(w):
+        return mpi_tpu.cart_create(w, (4,), periods=(True,))
+
+    out = run_world(fn_for, timeout=30.0)
+    for r in range(4):
+        halo, a2a = out[r]
+        assert halo == [(r - 1) % 4, (r + 1) % 4]
+        assert tuple(a2a[0]) == ("p", (r - 1) % 4)
+        assert tuple(a2a[1]) == ("m", (r + 1) % 4)
+
+
 def test_cross_host_group_p2p_raises_clearly():
     from mpi_tpu.comm import comm_world
 
