@@ -26,7 +26,7 @@ from .ring_attention import (
     zigzag_indices,
     zigzag_inverse_indices,
 )
-from .halo import halo_exchange, jacobi_step_1d
+from .halo import halo_exchange, jacobi_step_1d, jacobi_step_2d
 from .pipeline import pipeline, pipeline_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 
@@ -43,6 +43,7 @@ __all__ = [
     "zigzag_inverse_indices",
     "halo_exchange",
     "jacobi_step_1d",
+    "jacobi_step_2d",
     "pipeline",
     "pipeline_sharded",
     "ulysses_attention",
