@@ -39,9 +39,13 @@ from .api import (
     rank,
     receive,
     Request,
+    PersistentRequest,
     isend,
     irecv,
+    send_init,
+    recv_init,
     waitall,
+    waitany,
     reduce,
     reduce_scatter,
     register,
@@ -79,9 +83,13 @@ __all__ = [
     "rank",
     "receive",
     "Request",
+    "PersistentRequest",
     "isend",
     "irecv",
+    "send_init",
+    "recv_init",
     "waitall",
+    "waitany",
     "reduce",
     "reduce_scatter",
     "register",

@@ -35,7 +35,8 @@ back to generic tree/ring algorithms built on ``send``/``receive``
 from __future__ import annotations
 
 import threading
-from typing import TYPE_CHECKING, Any, List, Optional, Protocol, runtime_checkable
+from typing import (TYPE_CHECKING, Any, Callable, List, Optional, Protocol,
+                    Tuple, runtime_checkable)
 
 if TYPE_CHECKING:
     from .collectives_generic import OpLike
@@ -54,9 +55,13 @@ __all__ = [
     "receive",
     "sendrecv",
     "Request",
+    "PersistentRequest",
     "isend",
     "irecv",
+    "send_init",
+    "recv_init",
     "waitall",
+    "waitany",
     "reduce",
     "allreduce",
     "reduce_scatter",
@@ -515,6 +520,91 @@ def waitall(requests: List[Request],
             raise exc from first_exc
         raise first_exc
     return results
+
+
+class PersistentRequest:
+    """A restartable communication operation (MPI_Send_init /
+    MPI_Recv_init): the envelope — peer, tag, and for sends a payload
+    *supplier* — is fixed once, then each :meth:`start` launches one
+    instance and :meth:`wait` completes it, freeing the ``{peer, tag}``
+    pair for the next ``start``. The idiom for fixed communication
+    patterns in iterative codes (halo exchanges, pipelined rings), where
+    MPI amortizes envelope setup; here it amortizes the closure and
+    keeps the call sites declarative."""
+
+    def __init__(self, fn: Callable[[], Any]):
+        self._fn = fn
+        self._active: Optional[Request] = None
+
+    def start(self) -> "PersistentRequest":
+        """Launch one instance. Every started instance must be completed
+        with :meth:`wait` before the next ``start`` (the MPI contract) —
+        otherwise a quickly-failed instance's stored error (or a
+        receive's payload) would be silently discarded here."""
+        if self._active is not None:
+            if not self._active.test():
+                raise MpiError(
+                    "mpi_tpu: PersistentRequest.start() while the "
+                    "previous instance is still in flight; wait() first")
+            raise MpiError(
+                "mpi_tpu: PersistentRequest.start() before wait() on the "
+                "completed previous instance (its result/error would be "
+                "lost)")
+        self._active = Request(self._fn)
+        return self
+
+    def test(self) -> bool:
+        return self._active is not None and self._active.test()
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        """Complete the in-flight instance (payload for receives)."""
+        if self._active is None:
+            raise MpiError(
+                "mpi_tpu: PersistentRequest.wait() before start()")
+        active, self._active = self._active, None
+        return active.wait(timeout)
+
+
+def send_init(data_or_supplier: Any, dest: int, tag: int) -> PersistentRequest:
+    """Persistent send (MPI_Send_init). ``data_or_supplier`` may be the
+    payload itself (same bytes every start) or a zero-arg callable
+    evaluated at each :meth:`~PersistentRequest.start` — the analogue of
+    MPI's buffer re-read, for payloads that change between iterations."""
+    _require_init()
+    if callable(data_or_supplier):
+        return PersistentRequest(
+            lambda: send(data_or_supplier(), dest, tag))
+    return PersistentRequest(lambda: send(data_or_supplier, dest, tag))
+
+
+def recv_init(source: int, tag: int,
+              out: Optional[Any] = None) -> PersistentRequest:
+    """Persistent receive (MPI_Recv_init); each completed ``wait()``
+    returns that instance's payload."""
+    _require_init()
+    return PersistentRequest(lambda: receive(source, tag, out))
+
+
+def waitany(requests: List[Request],
+            timeout: Optional[float] = None) -> Tuple[int, Any]:
+    """Block until ANY request completes; return ``(index, result)`` and
+    leave the rest running (MPI_Waitany). Raises the completed
+    operation's error; ``MpiError`` if the deadline passes with nothing
+    done."""
+    import time as _time
+
+    if not requests:
+        raise MpiError("mpi_tpu: waitany on an empty request list")
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    while True:
+        for i, req in enumerate(requests):
+            if req.test():
+                return i, req.wait(0)
+        if deadline is not None and _time.monotonic() >= deadline:
+            raise MpiError(
+                f"mpi_tpu: waitany timed out after {timeout}s with "
+                f"{len(requests)} requests still running")
+        _time.sleep(0.0005)
 
 
 def scan(data: Any, op: "OpLike" = "sum") -> Any:

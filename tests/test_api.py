@@ -164,6 +164,76 @@ class TestNonblocking:
         assert out[0] == [None, None, None]
         assert out[1] == ["m0", "m1", "m2"]
 
+    def test_persistent_requests_halo_loop(self):
+        """send_init/recv_init restart across iterations (MPI_Send_init
+        semantics): one envelope, many instances, payload re-read each
+        start via the supplier form."""
+        from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+        def main():
+            import mpi_tpu
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            state = {"v": r}
+            got = []
+            if r == 0:
+                ps = mpi_tpu.send_init(lambda: state["v"], 1, 5)
+                for _ in range(3):
+                    ps.start().wait(30)
+                    state["v"] += 10
+            else:
+                pr = mpi_tpu.recv_init(0, 5)
+                for _ in range(3):
+                    pr.start()
+                    got.append(pr.wait(30))
+            mpi_tpu.finalize()
+            return got
+
+        out = run_spmd(main, n=2, net=XlaNetwork(n=2, oversubscribe=True))
+        assert out[1] == [0, 10, 20]
+
+    def test_persistent_restart_while_inflight_rejected(self):
+        import threading
+
+        gate = threading.Event()
+        ps = api.PersistentRequest(gate.wait)
+        ps.start()
+        with pytest.raises(api.MpiError, match="still in flight"):
+            ps.start()
+        gate.set()
+        with pytest.raises(api.MpiError, match="would be lost"):
+            # Completed but not waited: restarting would drop its result.
+            while not ps.test():
+                pass
+            ps.start()
+        ps.wait(10)
+        ps.start()  # restartable after wait()
+        ps.wait(10)
+        with pytest.raises(api.MpiError, match="before start"):
+            ps.wait(1)
+
+    def test_waitany_returns_first_done(self):
+        import threading
+
+        slow = threading.Event()
+        reqs = [api.Request(slow.wait), api.Request(lambda: "quick")]
+        idx, result = api.waitany(reqs, timeout=10)
+        assert (idx, result) == (1, "quick")
+        slow.set()
+        assert api.waitall([reqs[0]]) == [True]  # Event.wait's result
+
+    def test_waitany_timeout_and_empty(self):
+        import threading
+
+        gate = threading.Event()
+        try:
+            with pytest.raises(api.MpiError, match="timed out"):
+                api.waitany([api.Request(gate.wait)], timeout=0.2)
+        finally:
+            gate.set()
+        with pytest.raises(api.MpiError, match="empty"):
+            api.waitany([])
+
     def test_request_wait_returns_payload_and_frees_tag(self):
         class Echo(FakeBackend):
             def __init__(self):
