@@ -557,12 +557,30 @@ class PersistentRequest:
         return self._active is not None and self._active.test()
 
     def wait(self, timeout: Optional[float] = None) -> Any:
-        """Complete the in-flight instance (payload for receives)."""
+        """Complete the in-flight instance (payload for receives).
+
+        A timeout leaves the instance active so ``wait`` can be retried
+        — discarding it would orphan a live ``{peer, tag}`` operation
+        and lose its eventual result. Operation errors consume the
+        instance (it completed; ``start`` may be called again)."""
         if self._active is None:
             raise MpiError(
                 "mpi_tpu: PersistentRequest.wait() before start()")
-        active, self._active = self._active, None
-        return active.wait(timeout)
+        active = self._active
+        try:
+            result = active.wait(timeout)
+        except MpiError:
+            if not active.test():
+                raise  # genuine timeout: instance retained for retry
+            # Completed during the timeout window, or the operation's
+            # own MpiError: consume the instance and surface its outcome.
+            self._active = None
+            return active.wait(0)
+        except BaseException:
+            self._active = None  # completed with a non-MpiError failure
+            raise
+        self._active = None
+        return result
 
 
 def send_init(data_or_supplier: Any, dest: int, tag: int) -> PersistentRequest:
@@ -585,25 +603,33 @@ def recv_init(source: int, tag: int,
     return PersistentRequest(lambda: receive(source, tag, out))
 
 
-def waitany(requests: List[Request],
+def waitany(requests: List[Optional[Request]],
             timeout: Optional[float] = None) -> Tuple[int, Any]:
     """Block until ANY request completes; return ``(index, result)`` and
-    leave the rest running (MPI_Waitany). Raises the completed
-    operation's error; ``MpiError`` if the deadline passes with nothing
-    done."""
+    leave the rest running (MPI_Waitany). The completed slot is set to
+    ``None`` in the caller's list — MPI's MPI_REQUEST_NULL convention —
+    so the standard drain loop (`for _ in range(n): waitany(reqs)`)
+    visits every request exactly once; ``None`` slots are skipped.
+    Raises the completed operation's error; ``MpiError`` if every slot
+    is already ``None`` or the deadline passes with nothing done."""
     import time as _time
 
-    if not requests:
-        raise MpiError("mpi_tpu: waitany on an empty request list")
+    live = [i for i, r in enumerate(requests) if r is not None]
+    if not live:
+        raise MpiError(
+            "mpi_tpu: waitany with no live requests (empty list or all "
+            "slots already consumed)")
     deadline = None if timeout is None else _time.monotonic() + timeout
     while True:
-        for i, req in enumerate(requests):
+        for i in live:
+            req = requests[i]
             if req.test():
+                requests[i] = None  # consumed: MPI_REQUEST_NULL
                 return i, req.wait(0)
         if deadline is not None and _time.monotonic() >= deadline:
             raise MpiError(
                 f"mpi_tpu: waitany timed out after {timeout}s with "
-                f"{len(requests)} requests still running")
+                f"{len(live)} requests still running")
         _time.sleep(0.0005)
 
 

@@ -180,4 +180,6 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
         new_params = optax.apply_updates(state["params"], updates)
         return {"params": new_params, "opt": new_opt}, loss
 
-    return init_state, jax.jit(step)
+    # Donate the incoming state (params + opt alias their outputs — see
+    # make_train_step); callers rebind state each step.
+    return init_state, jax.jit(step, donate_argnums=(0,))

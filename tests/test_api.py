@@ -219,8 +219,30 @@ class TestNonblocking:
         reqs = [api.Request(slow.wait), api.Request(lambda: "quick")]
         idx, result = api.waitany(reqs, timeout=10)
         assert (idx, result) == (1, "quick")
+        assert reqs[1] is None  # consumed slot -> MPI_REQUEST_NULL
         slow.set()
-        assert api.waitall([reqs[0]]) == [True]  # Event.wait's result
+        # The drain loop visits the remaining request, not index 1 again.
+        idx2, result2 = api.waitany(reqs, timeout=10)
+        assert (idx2, result2) == (0, True)  # Event.wait's result
+        with pytest.raises(api.MpiError, match="no live requests"):
+            api.waitany(reqs, timeout=1)
+
+    def test_persistent_wait_timeout_is_retryable(self):
+        import threading
+
+        gate = threading.Event()
+        ps = api.PersistentRequest(gate.wait)
+        ps.start()
+        with pytest.raises(api.MpiError, match="timed out"):
+            ps.wait(0.05)
+        # The instance survived the timeout: no restart allowed, and a
+        # retried wait completes it.
+        with pytest.raises(api.MpiError, match="in flight"):
+            ps.start()
+        gate.set()
+        assert ps.wait(10) is True
+        ps.start()  # consumed -> restartable
+        ps.wait(10)
 
     def test_waitany_timeout_and_empty(self):
         import threading
@@ -231,7 +253,7 @@ class TestNonblocking:
                 api.waitany([api.Request(gate.wait)], timeout=0.2)
         finally:
             gate.set()
-        with pytest.raises(api.MpiError, match="empty"):
+        with pytest.raises(api.MpiError, match="no live requests"):
             api.waitany([])
 
     def test_request_wait_returns_payload_and_frees_tag(self):
