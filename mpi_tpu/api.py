@@ -488,19 +488,24 @@ def irecv(source: int, tag: int, out: Optional[Any] = None) -> Request:
     return Request(lambda: receive(source, tag, out))
 
 
-def waitall(requests: List[Request],
+def waitall(requests: List[Optional[Request]],
             timeout: Optional[float] = None) -> List[Any]:
     """Wait on every request; results in order; first error re-raised.
-    ``timeout`` is a TOTAL deadline across the whole set — a hung
-    request makes the call raise after ~``timeout`` seconds, not
-    ``len(requests) * timeout`` (requests still running at the deadline
-    are reported in the error and keep their daemon worker threads)."""
+    ``None`` slots (requests already consumed by :func:`waitany` —
+    MPI_REQUEST_NULL) are skipped with a ``None`` result. ``timeout`` is
+    a TOTAL deadline across the whole set — a hung request makes the
+    call raise after ~``timeout`` seconds, not ``len(requests) *
+    timeout`` (requests still running at the deadline are reported in
+    the error and keep their daemon worker threads)."""
     import time as _time
 
     deadline = None if timeout is None else _time.monotonic() + timeout
     results: List[Any] = []
     first_exc: Optional[BaseException] = None
     for req in requests:
+        if req is None:
+            results.append(None)
+            continue
         left = None if deadline is None else max(
             0.0, deadline - _time.monotonic())
         try:
@@ -510,7 +515,8 @@ def waitall(requests: List[Request],
                 first_exc = exc
             results.append(None)
     if first_exc is not None:
-        pending = [i for i, r in enumerate(requests) if not r.test()]
+        pending = [i for i, r in enumerate(requests)
+                   if r is not None and not r.test()]
         if pending:
             exc = MpiError(
                 f"mpi_tpu: waitall deadline expired with "
@@ -577,7 +583,12 @@ class PersistentRequest:
             self._active = None
             return active.wait(0)
         except BaseException:
-            self._active = None  # completed with a non-MpiError failure
+            # Consume only if the instance actually completed; an
+            # interrupted join (KeyboardInterrupt/SystemExit) leaves the
+            # operation live — keep it so a later wait() can finish it
+            # instead of orphaning a live {peer, tag}.
+            if active.test():
+                self._active = None
             raise
         self._active = None
         return result

@@ -269,6 +269,16 @@ class _MeshCollectives:
                 return C.bcast(x, root, "rank")
 
             out_specs = P()
+        elif kind == "prefix":
+            # Rank-order prefix reduction (scan/exscan). The
+            # ``deterministic`` slot carries ``exclusive`` for this kind
+            # (the order is always the fixed left fold).
+            def per_shard(x):
+                # x: (1, *shape) block; prefix over the mesh axis.
+                return C.prefix_reduce(x[0], "rank", op=op,
+                                       exclusive=deterministic)[None]
+
+            out_specs = P("rank")
         elif kind == "reduce_scatter":
             def per_shard(x):
                 # x: (1, L, *shape); each rank keeps its reduced L/n block.
@@ -558,6 +568,55 @@ class _MeshCollectives:
 
         return self._coll.run(self._myrank(), data, leader)
 
+    def scan(self, data: Any, op: "OpLike" = "sum") -> Any:
+        """Inclusive prefix reduction in rank order, as ONE compiled
+        program (``parallel.collectives.prefix_reduce`` — the jittable
+        MPI_Scan whose left-fold order is the cross-backend bitwise
+        contract); scalars, objects, and callable ops fold on the host
+        in the same order."""
+        return self._prefix(data, op, exclusive=False)
+
+    def exscan(self, data: Any, op: "OpLike" = "sum") -> Optional[Any]:
+        """Exclusive prefix reduction; rank 0 gets None (MPI_Exscan)."""
+        return self._prefix(data, op, exclusive=True)
+
+    def _prefix(self, data: Any, op: "OpLike", exclusive: bool) -> Any:
+        from ..collectives_generic import check_op, combine
+
+        check_op(op)
+
+        def leader(slots: List[Any]) -> List[Any]:
+            np_slots = self._uniform_arrays(slots)
+            # prefix_reduce's exclusive path builds the op identity,
+            # which does not exist for min/max over bool/complex — those
+            # (plus scalars, objects, callable ops, oversubscription)
+            # take the host fold, identical order.
+            no_identity = (exclusive and op in ("min", "max")
+                           and np_slots is not None
+                           and np_slots[0].dtype.kind not in "fiu")
+            if np_slots is None or callable(op) or self._mesh is None \
+                    or no_identity:
+                items = [np.asarray(s) for s in slots]
+                # One running left fold yields every rank's prefix in
+                # n-1 combines (the O(n^2) per-rank refold would be
+                # paid exactly where combines are most expensive).
+                prefixes: List[Any] = []
+                acc = items[0]
+                for it in items[1:]:
+                    prefixes.append(acc)
+                    acc = combine(acc, it, op)
+                if exclusive:
+                    return [None] + prefixes
+                return prefixes + [acc]
+            self._validate_payloads(np_slots)
+            fn = self._collective_fn("prefix", op, exclusive)
+            per = self._per_rank(fn(self._global_array(np_slots)))
+            if exclusive:
+                per = [None] + list(per[1:])  # rank 0: MPI_Exscan contract
+            return per
+
+        return self._coll.run(self._myrank(), data, leader)
+
 
 class XlaNetwork:
     """Backend implementing the :class:`mpi_tpu.api.Interface` SPI over a
@@ -777,6 +836,12 @@ class XlaNetwork:
                        deterministic: Optional[bool] = None) -> Any:
         return self._world_coll.reduce_scatter(data, op=op,
                                                deterministic=deterministic)
+
+    def scan(self, data: Any, op: "OpLike" = "sum") -> Any:
+        return self._world_coll.scan(data, op=op)
+
+    def exscan(self, data: Any, op: "OpLike" = "sum") -> Optional[Any]:
+        return self._world_coll.exscan(data, op=op)
 
     # -- communicator group engines ------------------------------------------
 

@@ -227,6 +227,13 @@ class TestNonblocking:
         with pytest.raises(api.MpiError, match="no live requests"):
             api.waitany(reqs, timeout=1)
 
+    def test_waitall_skips_consumed_none_slots(self):
+        reqs = [api.Request(lambda: "a"), api.Request(lambda: "b")]
+        idx, _ = api.waitany(reqs, timeout=10)   # nulls one slot
+        results = api.waitall(reqs, timeout=10)  # must not crash on None
+        assert results[idx] is None
+        assert results[1 - idx] in ("a", "b")
+
     def test_persistent_wait_timeout_is_retryable(self):
         import threading
 

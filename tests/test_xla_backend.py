@@ -224,6 +224,55 @@ class TestCollectives:
             assert (exc is None) if r == 0 else exc == sum(range(1, r + 1))
             assert mx == r
 
+    def test_array_scan_compiled_and_bitwise_vs_generic(self):
+        """Array payloads scan as ONE compiled program (prefix_reduce)
+        whose left-fold order is bitwise-identical to the generic
+        driver's host fold."""
+        from mpi_tpu.collectives_generic import _prefix_fold
+
+        rng = np.random.default_rng(11)
+        payloads = [rng.standard_normal(17).astype(np.float32)
+                    for _ in range(N)]
+
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            inc = mpi_tpu.scan(payloads[r])
+            exc = mpi_tpu.exscan(payloads[r])
+            mpi_tpu.finalize()
+            return np.asarray(inc), None if exc is None else np.asarray(exc)
+
+        net = XlaNetwork(n=N)
+        out = run_spmd(main, net=net)
+        assert ("prefix", "sum", False) in net._world_coll._jit_cache
+        assert ("prefix", "sum", True) in net._world_coll._jit_cache
+        for r in range(N):
+            want = _prefix_fold(payloads, r + 1, "sum")
+            assert out[r][0].tobytes() == want.tobytes()  # bitwise
+            if r == 0:
+                assert out[r][1] is None
+            else:
+                wexc = _prefix_fold(payloads, r, "sum")
+                assert out[r][1].tobytes() == wexc.tobytes()
+
+    def test_bool_exscan_minmax_takes_host_path(self):
+        """min/max have no traceable identity for bool payloads —
+        exscan must fold on the host instead of crashing in
+        prefix_reduce's identity construction."""
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            exc = mpi_tpu.exscan(np.array([r % 2 == 0, True]), op="min")
+            mpi_tpu.finalize()
+            return None if exc is None else np.asarray(exc).tolist()
+
+        out = spmd(main)
+        assert out[0] is None
+        for r in range(1, N):
+            # min over ranks 0..r-1: first element False once rank 1
+            # (odd -> False) is included.
+            assert out[r] == [r < 2, True]
+
     def test_reduce_root_only(self):
         def main():
             mpi_tpu.init()
