@@ -38,6 +38,8 @@ from .api import (
     init,
     rank,
     receive,
+    iprobe,
+    probe,
     Request,
     PersistentRequest,
     isend,
@@ -82,6 +84,8 @@ __all__ = [
     "init",
     "rank",
     "receive",
+    "iprobe",
+    "probe",
     "Request",
     "PersistentRequest",
     "isend",

@@ -54,6 +54,8 @@ __all__ = [
     "send",
     "receive",
     "sendrecv",
+    "iprobe",
+    "probe",
     "Request",
     "PersistentRequest",
     "isend",
@@ -269,6 +271,55 @@ def receive(source: int, tag: int, out: Optional[Any] = None) -> Any:
     trace.count("comm.receive.calls")
     trace.count("comm.receive.bytes", _payload_bytes(result))
     return result
+
+
+def _poll_until(predicate: Callable[[], bool], timeout: Optional[float],
+                what: str) -> None:
+    """Shared poll-until-deadline loop for blocking probes: raises
+    ``MpiError`` naming ``what`` when ``timeout`` elapses. The predicate
+    should be pre-validated (it runs every ~0.5 ms)."""
+    import time as _time
+
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    while not predicate():
+        if deadline is not None and _time.monotonic() >= deadline:
+            raise MpiError(
+                f"mpi_tpu: {what} timed out after {timeout}s")
+        _time.sleep(0.0005)
+
+
+def _iprobe_fn(impl: Interface) -> Callable[[int, int], bool]:
+    probe_fn = getattr(impl, "iprobe", None)
+    if probe_fn is None:
+        raise MpiError(
+            f"mpi_tpu: backend {type(impl).__name__} does not support "
+            f"iprobe")
+    return probe_fn
+
+
+def iprobe(source: int, tag: int) -> bool:
+    """Non-consuming message probe (MPI_Iprobe): True when a message
+    from ``source`` with ``tag`` is available — a matching ``receive``
+    would complete without blocking on the sender. Never consumes the
+    message and never blocks; raises the link failure if the peer's
+    connection is poisoned. (No reference analogue; the rendezvous
+    drivers report a parked/arrived sender.)"""
+    impl = _require_init()
+    _check_peer(source, impl)
+    _check_tag(tag)
+    return bool(_iprobe_fn(impl)(source, tag))
+
+
+def probe(source: int, tag: int, timeout: Optional[float] = None) -> None:
+    """Blocking probe (MPI_Probe): return once a message from ``source``
+    with ``tag`` is available (without consuming it); ``MpiError`` on
+    timeout."""
+    impl = _require_init()
+    _check_peer(source, impl)
+    _check_tag(tag)
+    probe_fn = _iprobe_fn(impl)
+    _poll_until(lambda: bool(probe_fn(source, tag)), timeout,
+                f"probe(source={source}, tag={tag})")
 
 
 def exchange(impl: Interface, data: Any, dest: int, source: int, tag: int,

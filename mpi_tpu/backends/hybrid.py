@@ -258,6 +258,16 @@ class HybridNetwork:
             return self._inner.cancel_receive(source - self._my_offset, tag)
         return self._tcp.cancel_receive(h, _compose_tag(source, me, tag))
 
+    def iprobe(self, source: int, tag: int) -> bool:
+        """Non-consuming MPI_Iprobe across the hierarchy: the inner
+        rendezvous for a local peer, the TCP tier (composed tag) for a
+        remote one."""
+        me = self.rank()
+        h = self._host_of(source)
+        if h == self._tcp.rank():
+            return self._inner.iprobe(source - self._my_offset, tag)
+        return self._tcp.iprobe(h, _compose_tag(source, me, tag))
+
     # -- hierarchical collectives --------------------------------------------
     #
     # The world is just the communicator group (0..size) with identity

@@ -78,6 +78,30 @@ class TagManager:
             if q is not None and q.empty():
                 del self._slots[tag]
 
+    def has_message(self, tag: int) -> bool:
+        """Non-consuming probe: a real payload (not a cancellation
+        token) is buffered for ``tag`` — on this transport a message is
+        'available' exactly when the sender's frame has already arrived.
+        A poisoned direction (peer died) or a buffered routed failure
+        RAISES instead of returning False: the matching receive would
+        raise immediately, and a blocking probe polling a dead link
+        would otherwise spin forever."""
+        with self._lock:
+            dead = self._dead
+            q = self._slots.get(tag)
+        if q is not None:
+            with q.mutex:
+                items = list(q.queue)
+            if any(not isinstance(item, (Cancel, BaseException))
+                   for item in items):
+                return True
+            for item in items:
+                if isinstance(item, BaseException):
+                    raise item
+        if dead is not None:
+            raise dead
+        return False
+
     def route(self, tag: int, item: Any) -> None:
         """Deliver an inbound item to the tag's slot (creating it if the
         matching call hasn't arrived yet)."""
@@ -166,6 +190,14 @@ class Rendezvous:
             return True
         except queue.Full:  # pragma: no cover - sender_engaged excludes this
             return False
+
+    def probe(self, tag: int) -> bool:
+        """Non-consuming probe: True when a sender has arrived and is
+        parked at the rendezvous for ``tag`` (its payload is immediately
+        receivable)."""
+        with self._lock:
+            ent = self._entries.get(tag)
+            return ent is not None and ent.creator == self._SENDER
 
     def send(self, tag: int, payload: Any) -> None:
         ent = self._entry(tag, self._SENDER)

@@ -326,6 +326,16 @@ class TcpNetwork:
             return self._local.cancel(tag, exc)
         return self._peers[source].receivetags.cancel(tag, exc)
 
+    def iprobe(self, source: int, tag: int) -> bool:
+        """Non-consuming MPI_Iprobe: True when a message from ``source``
+        with ``tag`` is already available — its data frame arrived (the
+        sender is blocked awaiting the rendezvous ack), or a self-send
+        is parked at the local rendezvous."""
+        self._check_rank(source)
+        if source == self._rank:
+            return self._local.probe(tag)
+        return self._peers[source].receivetags.has_message(tag)
+
     # -- bootstrap ----------------------------------------------------------
 
     def _is_unix(self) -> bool:

@@ -587,19 +587,21 @@ class _MeshCollectives:
 
         def leader(slots: List[Any]) -> List[Any]:
             np_slots = self._uniform_arrays(slots)
-            # prefix_reduce's exclusive path builds the op identity,
-            # which does not exist for min/max over bool/complex — those
-            # (plus scalars, objects, callable ops, oversubscription)
-            # take the host fold, identical order.
-            no_identity = (exclusive and op in ("min", "max")
-                           and np_slots is not None
-                           and np_slots[0].dtype.kind not in "fiu")
+            # The compiled path is float/int/uint only: jnp's
+            # add/multiply/minimum/maximum reject bool and complex in
+            # ways numpy's don't, and prefix_reduce's exclusive identity
+            # doesn't exist for them either — those (plus scalars,
+            # objects, callable ops, oversubscription) take the host
+            # fold, identical order.
             if np_slots is None or callable(op) or self._mesh is None \
-                    or no_identity:
-                items = [np.asarray(s) for s in slots]
-                # One running left fold yields every rank's prefix in
-                # n-1 combines (the O(n^2) per-rank refold would be
-                # paid exactly where combines are most expensive).
+                    or np_slots[0].dtype.kind not in "fiu":
+                # Raw slots (combine() normalizes operands), so rank 0's
+                # inclusive result stays the caller's own payload type —
+                # matching collectives_generic.scan. One running left
+                # fold yields every rank's prefix in n-1 combines (the
+                # O(n^2) per-rank refold would be paid exactly where
+                # combines are most expensive).
+                items = list(slots)
                 prefixes: List[Any] = []
                 acc = items[0]
                 for it in items[1:]:
@@ -798,6 +800,14 @@ class XlaNetwork:
         exc = ReceiveCancelled(
             f"mpi_tpu: receive(source={source}, tag={tag}) cancelled")
         return self._pair(source, me).cancel(tag, exc)
+
+    def iprobe(self, source: int, tag: int) -> bool:
+        """Non-consuming MPI_Iprobe: True when the sender is parked at
+        this pair's rendezvous with ``tag`` (a receive would complete
+        immediately)."""
+        me = self._myrank()
+        self._check_rank(source)
+        return self._pair(source, me).probe(tag)
 
     def _check_rank(self, r: int) -> None:
         if not 0 <= r < self._n:

@@ -254,6 +254,28 @@ class Comm:
                         source=source, tag=tag):
             return _exchange(self, data, dest, source, tag, out=out)
 
+    def iprobe(self, source: Optional[int], tag: int) -> bool:
+        """Non-consuming group probe (MPI_Iprobe). ``source=None``
+        (PROC_NULL) is immediately 'available' — the matching receive
+        completes at once with ``None``, per MPI."""
+        if source is None:
+            return True
+        self._check_peer(source)
+        probe_fn = getattr(self._impl, "iprobe", None)
+        if probe_fn is None:
+            raise MpiError(
+                f"mpi_tpu: backend {type(self._impl).__name__} does not "
+                f"support iprobe")
+        return bool(probe_fn(self._members[source], self._map_tag(tag)))
+
+    def probe(self, source: Optional[int], tag: int,
+              timeout: Optional[float] = None) -> None:
+        """Blocking group probe (MPI_Probe)."""
+        from .api import _poll_until
+
+        _poll_until(lambda: self.iprobe(source, tag), timeout,
+                    f"probe(source={source}, tag={tag})")
+
     def isend(self, data: Any, dest: int, tag: int) -> Request:
         """Nonblocking group send; ``wait()`` blocks until the rendezvous
         ack (same contract as :func:`mpi_tpu.isend`)."""

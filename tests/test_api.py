@@ -2,6 +2,7 @@
 
 import threading
 
+import numpy as np
 import pytest
 
 import mpi_tpu
@@ -226,6 +227,68 @@ class TestNonblocking:
         assert (idx2, result2) == (0, True)  # Event.wait's result
         with pytest.raises(api.MpiError, match="no live requests"):
             api.waitany(reqs, timeout=1)
+
+    def test_probe_iprobe_xla(self):
+        from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+        def main():
+            import mpi_tpu
+            import time
+
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            res = None
+            if r == 0:
+                assert mpi_tpu.iprobe(1, 7) is False  # nothing sent yet
+                mpi_tpu.barrier()
+                mpi_tpu.probe(1, 7, timeout=20)       # sender arrives
+                assert mpi_tpu.iprobe(1, 7) is True   # not consumed
+                res = mpi_tpu.receive(1, 7)
+                assert mpi_tpu.iprobe(1, 7) is False  # consumed now
+            else:
+                mpi_tpu.barrier()
+                time.sleep(0.05)
+                mpi_tpu.send(b"probed", 0, 7)
+            mpi_tpu.finalize()
+            return res
+
+        out = run_spmd(main, n=2, net=XlaNetwork(n=2, oversubscribe=True))
+        assert out[0] == b"probed"
+
+    def test_probe_tcp_buffered_frame(self):
+        from conftest import run_on_ranks, tcp_cluster
+
+        with tcp_cluster(2) as nets:
+            def body(net, r):
+                if r == 1:
+                    # The data frame buffers at rank 0 while this send
+                    # blocks awaiting the rendezvous ack.
+                    net.send(np.arange(3), 0, 9)
+                    return None
+                import time
+
+                deadline = time.monotonic() + 20
+                while not net.iprobe(1, 9):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("probe never saw the frame")
+                    time.sleep(0.001)
+                got = net.receive(1, 9)
+                assert not net.iprobe(1, 9)
+                return got
+
+            out = run_on_ranks(nets, body)
+        np.testing.assert_array_equal(out[0], np.arange(3))
+
+    def test_iprobe_raises_on_poisoned_link(self):
+        """A probe against a dead peer must raise (like the receive
+        would), not return False forever — a blocking probe with no
+        timeout would otherwise spin on the corpse."""
+        from mpi_tpu.backends.rendezvous import TagManager
+
+        tm = TagManager("receive", 1)
+        tm.poison(ConnectionError("peer died"))
+        with pytest.raises(ConnectionError, match="peer died"):
+            tm.has_message(5)
 
     def test_waitall_skips_consumed_none_slots(self):
         reqs = [api.Request(lambda: "a"), api.Request(lambda: "b")]

@@ -285,6 +285,32 @@ class TestTagIsolation:
         out = spmd(main, n=2)
         assert all(o == (2.0, 4.0) for o in out)
 
+    def test_group_probe(self):
+        def main():
+            import time
+
+            mpi_tpu.init()
+            w = comm_world()
+            sub = w.split(color=0)
+            got = None
+            if sub.rank() == 0:
+                assert sub.iprobe(1, 6) is False
+                sub.barrier()
+                sub.probe(1, 6, timeout=20)
+                assert sub.iprobe(1, 6) is True
+                got = sub.receive(1, 6)
+                assert sub.iprobe(1, 6) is False
+                assert sub.iprobe(None, 6) is True  # PROC_NULL
+            else:
+                sub.barrier()
+                time.sleep(0.05)
+                sub.send(b"g-probe", 0, 6)
+            mpi_tpu.finalize()
+            return got
+
+        out = spmd(main, n=2)
+        assert out[0] == b"g-probe"
+
     def test_group_isend_irecv(self):
         def main():
             mpi_tpu.init()

@@ -256,22 +256,28 @@ class TestCollectives:
                 assert out[r][1].tobytes() == wexc.tobytes()
 
     def test_bool_exscan_minmax_takes_host_path(self):
-        """min/max have no traceable identity for bool payloads —
-        exscan must fold on the host instead of crashing in
-        prefix_reduce's identity construction."""
+        """bool/complex payloads fold on the host (jnp rejects them in
+        ways numpy doesn't; exclusive min/max also lack an identity) —
+        inclusive scan included, and scalars keep their native type."""
         def main():
             mpi_tpu.init()
             r = mpi_tpu.rank()
             exc = mpi_tpu.exscan(np.array([r % 2 == 0, True]), op="min")
+            inc = mpi_tpu.scan(np.array([r % 2 == 0, True]), op="min")
+            scalar = mpi_tpu.scan(1.5)
             mpi_tpu.finalize()
-            return None if exc is None else np.asarray(exc).tolist()
+            return (None if exc is None else np.asarray(exc).tolist(),
+                    np.asarray(inc).tolist(), scalar)
 
         out = spmd(main)
-        assert out[0] is None
-        for r in range(1, N):
-            # min over ranks 0..r-1: first element False once rank 1
-            # (odd -> False) is included.
-            assert out[r] == [r < 2, True]
+        assert out[0][0] is None
+        assert isinstance(out[0][2], float)  # rank 0 keeps its payload
+        for r in range(N):
+            exc, inc, scalar = out[r]
+            if r >= 1:
+                assert exc == [r < 2, True]
+            assert inc == [r < 1, True]
+            assert float(scalar) == 1.5 * (r + 1)
 
     def test_reduce_root_only(self):
         def main():
