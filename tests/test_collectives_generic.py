@@ -2,7 +2,8 @@
 
 These are NEW capability vs the reference (AllReduce is a stub, mpi.go:130);
 the deterministic tree order defined here is the bitwise contract the XLA
-driver's deterministic path must match (see test_bitwise.py)."""
+driver's deterministic path must match (see the TCP-vs-XLA parity tests
+in test_xla_backend.py)."""
 
 import numpy as np
 import pytest
