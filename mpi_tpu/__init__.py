@@ -32,6 +32,15 @@ from .api import (
     allreduce,
     alltoall,
     barrier,
+    iallreduce,
+    ireduce,
+    ibcast,
+    igather,
+    iallgather,
+    iscatter,
+    ialltoall,
+    ireduce_scatter,
+    ibarrier,
     bcast,
     finalize,
     gather,
@@ -78,6 +87,15 @@ __all__ = [
     "allreduce",
     "alltoall",
     "barrier",
+    "iallreduce",
+    "ireduce",
+    "ibcast",
+    "igather",
+    "iallgather",
+    "iscatter",
+    "ialltoall",
+    "ireduce_scatter",
+    "ibarrier",
     "bcast",
     "finalize",
     "gather",

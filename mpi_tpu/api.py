@@ -75,6 +75,15 @@ __all__ = [
     "scan",
     "exscan",
     "barrier",
+    "iallreduce",
+    "ireduce",
+    "ibcast",
+    "igather",
+    "iallgather",
+    "iscatter",
+    "ialltoall",
+    "ireduce_scatter",
+    "ibarrier",
     "Raw",
     "MpiError",
     "TagError",
@@ -213,6 +222,14 @@ def finalize() -> None:
     gates ``_require_init``."""
     global _init_count
     impl = registered()
+    # Drain and drop this thread's nonblocking-collective chain: a
+    # retained tail request would pin its result, and a stale entry
+    # could chain a future run (id() reuse) onto this one's corpse.
+    chains = getattr(_icoll_tls, "chains", None)
+    if chains:
+        for key in [k for k in chains if k[0] == id(impl)]:
+            _drain_chain(key)
+            chains.pop(key, None)
     with _lock:
         _init_count = max(0, _init_count - 1)
     impl.finalize()
@@ -409,6 +426,10 @@ def _check_tag(tag: int) -> None:
 
 def _collective(name: str, *args: Any, **kwargs: Any) -> Any:
     impl = _require_init()
+    # A blocking collective must not race this thread's outstanding
+    # nonblocking ones into the positional rendezvous (see
+    # _drain_chain); it joins the chain by draining it first.
+    _drain_chain((id(impl), 0))
     native = getattr(impl, name, None)
     if native is not None:
         call = lambda: native(*args, **kwargs)  # noqa: E731
@@ -693,6 +714,102 @@ def waitany(requests: List[Optional[Request]],
                 f"mpi_tpu: waitany timed out after {timeout}s with "
                 f"{len(live)} requests still running")
         _time.sleep(0.0005)
+
+
+# ---------------------------------------------------------------------------
+# Nonblocking collectives (MPI-3 MPI_Iallreduce family): the blocking
+# collective launched on a worker thread, completion via Request — the
+# same doctrine as isend/irecv ("callers use goroutines", made
+# first-class). The MPI ordering rule carries over: every rank must
+# START its nonblocking collectives in the same order — and because the
+# drivers match collectives positionally (shared barrier sessions /
+# sequential tag blocks), consecutive nonblocking collectives on the
+# same communicator are internally CHAINED in launch order: each
+# executes only after the previous one launched by this thread
+# completed. Progress therefore overlaps with the caller's compute
+# (the point of I-collectives), not with each other — racing worker
+# threads into the rendezvous would otherwise pair rank A's allreduce
+# with rank B's bcast.
+# ---------------------------------------------------------------------------
+
+_icoll_tls = threading.local()
+
+
+def _chain_slot(key: Any) -> Optional["Request"]:
+    """This thread's outstanding chained request for ``key`` (pruned
+    once complete, so finished results don't stay pinned)."""
+    chains = getattr(_icoll_tls, "chains", None)
+    if chains is None:
+        chains = _icoll_tls.chains = {}
+    prev = chains.get(key)
+    if prev is not None and prev.test():
+        del chains[key]
+        prev = None
+    return prev
+
+
+def _drain_chain(key: Any) -> None:
+    """Complete any outstanding chained i-collective for ``key`` before
+    a BLOCKING collective on the same communicator proceeds — otherwise
+    the blocking call would race the chained worker into the positional
+    rendezvous and mismatch collective kinds across ranks. Errors stay
+    with their own request."""
+    prev = _chain_slot(key)
+    if prev is not None:
+        try:
+            prev.wait()
+        except BaseException:  # noqa: BLE001 — surfaced on prev's owner
+            pass
+        _chain_slot(key)  # prune the completed entry
+
+
+def _chained_request(key: Any, fn: Callable[[], Any]) -> "Request":
+    """Launch ``fn`` on a worker thread AFTER the previous chained
+    request for ``key`` (per launching thread) completes; errors stay
+    with their own request (the successor still runs — matching MPI,
+    where a failed collective does not cancel queued ones)."""
+    prev = _chain_slot(key)
+
+    def run() -> Any:
+        if prev is not None:
+            try:
+                prev.wait()
+            except BaseException:  # noqa: BLE001 — surfaced on prev
+                pass
+        return fn()
+
+    req = Request(run)
+    _icoll_tls.chains[key] = req
+    return req
+
+
+def _icollective(name: str) -> Callable[..., "Request"]:
+    def launch(*args: Any, **kwargs: Any) -> Request:
+        impl = _require_init()
+        blocking = globals()[name]
+        return _chained_request((id(impl), 0),
+                                lambda: blocking(*args, **kwargs))
+
+    launch.__name__ = f"i{name}"
+    launch.__qualname__ = f"i{name}"
+    launch.__doc__ = (
+        f"Nonblocking {name} (MPI_I{name}): starts the "
+        f"collective and returns a :class:`Request`; ``wait()`` yields "
+        f"what blocking :func:`{name}` returns. All ranks must start "
+        f"their nonblocking collectives in the same order; consecutive "
+        f"ones chain in launch order (overlap is with caller compute).")
+    return launch
+
+
+iallreduce = _icollective("allreduce")
+ireduce = _icollective("reduce")
+ibcast = _icollective("bcast")
+igather = _icollective("gather")
+iallgather = _icollective("allgather")
+iscatter = _icollective("scatter")
+ialltoall = _icollective("alltoall")
+ireduce_scatter = _icollective("reduce_scatter")
+ibarrier = _icollective("barrier")
 
 
 def scan(data: Any, op: "OpLike" = "sum") -> Any:

@@ -376,8 +376,12 @@ class Comm:
     # generic algorithms over the translated SPI (self).
 
     def _coll(self, name: str, *args: Any, **kwargs: Any) -> Any:
+        from .api import _drain_chain
         from .utils import trace
 
+        # Blocking group collectives join this thread's nonblocking
+        # chain for the communicator (see api._drain_chain).
+        _drain_chain((id(self._impl), self._ctx))
         if not trace.enabled():
             return self._coll_inner(name, *args, **kwargs)
         from .api import _payload_bytes
@@ -448,6 +452,50 @@ class Comm:
 
     def barrier(self) -> None:
         return self._coll("barrier")
+
+    # -- nonblocking collectives (MPI-3 I-variants) ------------------------
+    #
+    # The blocking group collective on a worker thread, completion via
+    # Request — same contract as the facade's iallreduce family: every
+    # member must START its nonblocking collectives in the same order,
+    # and consecutive ones on the same communicator chain in launch
+    # order (see api._chained_request — racing worker threads into the
+    # shared rendezvous would mismatch collective kinds across ranks).
+
+    def _icoll(self, name: str, *args: Any, **kwargs: Any) -> Request:
+        from .api import _chained_request
+
+        return _chained_request(
+            (id(self._impl), self._ctx),
+            lambda: getattr(self, name)(*args, **kwargs))
+
+    def iallreduce(self, data: Any, op: "OpLike" = "sum") -> Request:
+        return self._icoll("allreduce", data, op=op)
+
+    def ireduce(self, data: Any, root: int = 0,
+                op: "OpLike" = "sum") -> Request:
+        return self._icoll("reduce", data, root=root, op=op)
+
+    def ibcast(self, data: Any, root: int = 0) -> Request:
+        return self._icoll("bcast", data, root=root)
+
+    def igather(self, data: Any, root: int = 0) -> Request:
+        return self._icoll("gather", data, root=root)
+
+    def iallgather(self, data: Any) -> Request:
+        return self._icoll("allgather", data)
+
+    def iscatter(self, data: Optional[List[Any]], root: int = 0) -> Request:
+        return self._icoll("scatter", data, root=root)
+
+    def ialltoall(self, data: List[Any]) -> Request:
+        return self._icoll("alltoall", data)
+
+    def ireduce_scatter(self, data: Any, op: "OpLike" = "sum") -> Request:
+        return self._icoll("reduce_scatter", data, op=op)
+
+    def ibarrier(self) -> Request:
+        return self._icoll("barrier")
 
     # -- construction ------------------------------------------------------
 

@@ -279,6 +279,65 @@ class TestNonblocking:
             out = run_on_ranks(nets, body)
         np.testing.assert_array_equal(out[0], np.arange(3))
 
+    def test_nonblocking_collectives_overlap(self):
+        """MPI-3 I-variants: start several collectives, compute
+        'locally', complete them later; same-order launch on every rank."""
+        from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+        def main():
+            import mpi_tpu
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            r1 = mpi_tpu.iallreduce(np.float32([r + 1.0]))
+            r2 = mpi_tpu.ibcast({"cfg": 7} if r == 0 else None, root=0)
+            r3 = mpi_tpu.ibarrier()
+            local = r * 10  # overlapped "work"
+            total = mpi_tpu.waitall([r1, r2, r3], timeout=30)
+            mpi_tpu.finalize()
+            return float(np.asarray(total[0])[0]), total[1], local
+
+        out = run_spmd(main, n=4, net=XlaNetwork(n=4, oversubscribe=True))
+        for r, (total, cfg, local) in enumerate(out):
+            assert total == 1 + 2 + 3 + 4
+            assert cfg == {"cfg": 7}
+            assert local == r * 10
+
+    def test_blocking_collective_joins_nonblocking_chain(self):
+        """The MPI-legal mix `iallreduce(...); bcast(...)` without an
+        intervening wait: the blocking collective must drain the chain
+        instead of racing the worker into the rendezvous (which would
+        pair different collective kinds across ranks)."""
+        from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+        def main():
+            import mpi_tpu
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            req = mpi_tpu.iallreduce(np.float32([r + 1.0]))
+            got = mpi_tpu.bcast({"k": 1} if r == 0 else None, root=0)
+            total = req.wait(30)
+            mpi_tpu.finalize()
+            return got, float(np.asarray(total)[0])
+
+        out = run_spmd(main, n=4, net=XlaNetwork(n=4, oversubscribe=True))
+        assert all(o == ({"k": 1}, 10.0) for o in out)
+
+    def test_group_nonblocking_collectives(self):
+        from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+        def main():
+            import mpi_tpu
+            mpi_tpu.init()
+            sub = mpi_tpu.comm_world().split(color=mpi_tpu.rank() % 2)
+            req = sub.iallreduce(np.float32(mpi_tpu.rank()))
+            sub.ibarrier().wait(30)
+            total = req.wait(30)
+            mpi_tpu.finalize()
+            return float(total)
+
+        out = run_spmd(main, n=4, net=XlaNetwork(n=4, oversubscribe=True))
+        assert [o for o in out] == [2.0, 4.0, 2.0, 4.0]
+
     def test_iprobe_raises_on_poisoned_link(self):
         """A probe against a dead peer must raise (like the receive
         would), not return False forever — a blocking probe with no
