@@ -21,6 +21,7 @@ point-to-point library over TCP, /root/reference) designed TPU-first:
 """
 
 from .comm import CartComm, Comm, cart_create, comm_world
+from .window import Window, win_create
 from .runner import run_main, selected_backend
 from .api import (
     Interface,
@@ -74,6 +75,8 @@ __version__ = "0.1.0"
 __all__ = [
     "Comm",
     "CartComm",
+    "Window",
+    "win_create",
     "cart_create",
     "comm_world",
     "run_main",

@@ -758,8 +758,13 @@ def _drain_chain(key: Any) -> None:
     if prev is not None:
         try:
             prev.wait()
-        except BaseException:  # noqa: BLE001 — surfaced on prev's owner
-            pass
+        except BaseException:
+            # prev's own stored error belongs to prev's owner — swallow.
+            # But an interrupt of the join (KeyboardInterrupt/SystemExit
+            # with prev still live) must propagate: proceeding would race
+            # the still-running worker into the rendezvous.
+            if not prev.test():
+                raise
         _chain_slot(key)  # prune the completed entry
 
 

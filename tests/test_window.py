@@ -1,0 +1,250 @@
+"""RMA window tests (MPI_Win active-target): put/get/accumulate complete
+at fences, deterministically, over both the xla and tcp drivers."""
+
+import numpy as np
+import pytest
+
+import mpi_tpu
+from mpi_tpu import api
+from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+from mpi_tpu.comm import comm_world
+
+from conftest import run_on_ranks, tcp_cluster
+
+N = 4
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    api._reset_for_testing()
+    yield
+    api._reset_for_testing()
+
+
+def spmd(fn, n=N, **kw):
+    return run_spmd(fn, n=n, **kw)
+
+
+class TestPutGet:
+    def test_ring_put_visible_after_fence(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            win = mpi_tpu.win_create(w, np.zeros(2, np.float32))
+            win.put(np.float32([r, r * 10]), (r + 1) % n)
+            before = win.local.copy()  # not yet visible
+            win.fence()
+            mpi_tpu.finalize()
+            return before.tolist(), win.local.tolist()
+
+        out = spmd(main)
+        for r in range(N):
+            before, after = out[r]
+            assert before == [0.0, 0.0]
+            src = (r - 1) % N
+            assert after == [float(src), float(src * 10)]
+
+    def test_get_observes_epoch_puts(self):
+        """Within one epoch, puts land before gets are served — every
+        rank's get of rank 0's window sees the put from rank 1."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            win = mpi_tpu.win_create(w, np.zeros(3, np.float64))
+            if r == 1:
+                win.put(np.float64([7.0, 8.0, 9.0]), 0)
+            h = win.get(0)
+            with pytest.raises(mpi_tpu.MpiError, match="before the"):
+                _ = h.array  # undefined until the fence
+            win.fence()
+            mpi_tpu.finalize()
+            return h.array.tolist()
+
+        out = spmd(main)
+        assert all(o == [7.0, 8.0, 9.0] for o in out)
+
+    def test_partial_spans_and_counts(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            win = mpi_tpu.win_create(w, np.arange(8, dtype=np.float32))
+            if r == 3:
+                win.put(np.float32([-1.0, -2.0]), 0, offset=4)
+            h = win.get(0, offset=3, count=4)
+            win.fence()
+            mpi_tpu.finalize()
+            return h.array.tolist()
+
+        out = spmd(main)
+        assert all(o == [3.0, -1.0, -2.0, 6.0] for o in out)
+
+    def test_bad_target_raises_mpi_error(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            win = mpi_tpu.win_create(w, np.zeros(2, np.float32))
+            try:
+                with pytest.raises(mpi_tpu.MpiError, match="out of range"):
+                    win.get(7)  # default count must not IndexError first
+            finally:
+                win.fence()
+                mpi_tpu.finalize()
+
+        spmd(main, n=2)
+
+    def test_unpicklable_accumulate_op_rejected_at_issue(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            win = mpi_tpu.win_create(w, np.zeros(2, np.float64))
+            try:
+                with pytest.raises(mpi_tpu.MpiError, match="picklable"):
+                    win.accumulate(np.zeros(2), 0, op=lambda a, b: a + b)
+                # A module-level callable is fine.
+                win.accumulate(np.float64([1.0, 2.0]), 0, op=np.maximum)
+            finally:
+                win.fence()
+                mpi_tpu.finalize()
+            return win.local.tolist()
+
+        out = spmd(main, n=2)
+        assert out[0] == [1.0, 2.0]
+
+    def test_bounds_checked_at_issue(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            win = mpi_tpu.win_create(w, np.zeros(4, np.float32))
+            try:
+                with pytest.raises(mpi_tpu.MpiError, match="outside"):
+                    win.put(np.zeros(3, np.float32), 0, offset=2)
+                with pytest.raises(mpi_tpu.MpiError, match="outside"):
+                    win.get(1, offset=5)
+            finally:
+                win.fence()  # stay collective with peers
+                mpi_tpu.finalize()
+
+        spmd(main, n=2)
+
+
+class TestAccumulate:
+    def test_all_ranks_sum_into_root(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            win = mpi_tpu.win_create(w, np.zeros(2, np.float64))
+            win.accumulate(np.float64([r + 1.0, 1.0]), 0, op="sum")
+            win.fence()
+            mpi_tpu.finalize()
+            return win.local.tolist()
+
+        out = spmd(main)
+        assert out[0] == [1.0 + 2 + 3 + 4, float(N)]
+        for r in range(1, N):
+            assert out[r] == [0.0, 0.0]
+
+    def test_overlapping_puts_are_source_rank_ordered(self):
+        """MPI leaves overlapping puts undefined; here the LAST source
+        rank wins deterministically (source-rank apply order)."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            win = mpi_tpu.win_create(w, np.zeros(1, np.float32))
+            win.put(np.float32([r + 1.0]), 0)  # everyone targets rank 0
+            win.fence()
+            mpi_tpu.finalize()
+            return float(win.local[0])
+
+        out = spmd(main)
+        assert out[0] == float(N)  # highest source rank applied last
+
+    def test_multi_epoch_and_local_access(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            win = mpi_tpu.win_create(w, np.zeros(1, np.float64))
+            for _ in range(3):
+                win.accumulate(np.float64([1.0]), (r + 1) % n)
+                win.fence()
+            local_seen = float(win.local[0])  # legal between fences
+            win.local[0] += 100.0             # direct local store
+            win.fence()
+            mpi_tpu.finalize()
+            return local_seen, float(win.local[0]), win.epoch
+
+        out = spmd(main)
+        assert all(o == (3.0, 103.0, 4) for o in out)
+
+
+class TestLifecycle:
+    def test_free_with_pending_rma_raises(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            win = mpi_tpu.win_create(w, np.zeros(1, np.float32))
+            win.put(np.float32([1.0]), 0)
+            try:
+                with pytest.raises(mpi_tpu.MpiError, match="pending"):
+                    win.free()
+            finally:
+                win.fence()
+                win.free()
+                mpi_tpu.finalize()
+
+        spmd(main, n=2)
+
+    def test_dtype_mismatch_rejected(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            dt = np.float32 if w.rank() == 0 else np.float64
+            try:
+                with pytest.raises(mpi_tpu.MpiError, match="dtype"):
+                    mpi_tpu.win_create(w, np.zeros(2, dt))
+            finally:
+                mpi_tpu.finalize()
+
+        spmd(main, n=2)
+
+    def test_heterogeneous_extents(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            win = mpi_tpu.win_create(w, np.zeros(r + 1, np.float32))
+            win.put(np.full(r + 1, 5.0, np.float32), r)  # self-put
+            h = win.get((r + 1) % w.size())
+            win.fence()
+            mpi_tpu.finalize()
+            return win.local.tolist(), len(h.array)
+
+        out = spmd(main)
+        for r in range(N):
+            local, got_len = out[r]
+            assert local == [5.0] * (r + 1)
+            assert got_len == ((r + 1) % N) + 1
+
+
+class TestTcpDriver:
+    def test_rma_over_tcp_cluster(self):
+        with tcp_cluster(3) as nets:
+            def body(net, r):
+                w = comm_world(net)
+                win = mpi_tpu.win_create(w, np.zeros(2, np.float64))
+                win.accumulate(np.float64([r + 1.0, 0.0]), 0)
+                win.put(np.float64([float(r)]), (r + 1) % 3, offset=1)
+                h = win.get(0, count=1)
+                win.fence()
+                return win.local.tolist(), h.array.tolist()
+
+            out = run_on_ranks(nets, body)
+        assert out[0][0] == [6.0, 2.0]   # 1+2+3 accumulated; put from 2
+        assert out[1][0] == [0.0, 0.0]
+        assert out[2][0] == [0.0, 1.0]
+        assert all(o[1] == [6.0] for o in out)  # gets see the epoch's accs
