@@ -150,3 +150,27 @@ def run_on_ranks(nets, fn, timeout: float = 30.0):
         if e is not None:
             raise e
     return results
+
+
+def run_hybrid_world(fn_for, hosts: int = 2, local: int = 2,
+                     timeout: float = 60.0):
+    """Run fn_for(net)() on every rank of a hosts x local hybrid world
+    (one HybridNetwork per simulated host, threads standing in for host
+    processes); returns results indexed by global rank. The thread
+    harness is run_on_ranks — one copy of the fan-out/join/error logic.
+    Shared by test_hybrid and the cross-backend torture test."""
+    from mpi_tpu.backends.hybrid import HybridNetwork, run_spmd_hybrid
+    from mpi_tpu.backends.tcp import TcpNetwork
+
+    ports = _free_ports(hosts)
+    addrs = sorted(f"127.0.0.1:{p:05d}" for p in ports)
+    nets = [HybridNetwork(
+        local_ranks=local,
+        tcp=TcpNetwork(addr=a, addrs=list(addrs), timeout=30.0,
+                       proto="tcp")) for a in addrs]
+    per_host = run_on_ranks(
+        nets,
+        lambda net, h: run_spmd_hybrid(fn_for(net), net,
+                                       register_facade=False),
+        timeout=timeout)
+    return [per_host[h][l] for h in range(hosts) for l in range(local)]

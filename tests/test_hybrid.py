@@ -12,9 +12,6 @@ import threading
 import numpy as np
 import pytest
 
-from conftest import _free_ports
-from mpi_tpu.backends.hybrid import HybridNetwork, run_spmd_hybrid
-from mpi_tpu.backends.tcp import TcpNetwork
 
 HOSTS = 2
 LOCAL = 2
@@ -22,40 +19,13 @@ WORLD = HOSTS * LOCAL
 
 
 def run_world(fn_for, local=LOCAL, hosts=HOSTS, timeout=60.0):
-    """Run fn_for(net)() on every rank of a hosts x local world; returns
-    results indexed by global rank."""
-    ports = _free_ports(hosts)
-    addrs = sorted(f"127.0.0.1:{p:05d}" for p in ports)
-    nets = [HybridNetwork(
-        local_ranks=local,
-        tcp=TcpNetwork(addr=a, addrs=list(addrs), timeout=30.0, proto="tcp"))
-        for a in addrs]
-    results = [None] * hosts
-    errors = [None] * hosts
+    """Shared harness (conftest.run_hybrid_world) with this module's
+    default 2x2 world."""
+    from conftest import run_hybrid_world
 
-    def host_main(h):
-        try:
-            results[h] = run_spmd_hybrid(fn_for(nets[h]), nets[h],
-                                         register_facade=False)
-        except BaseException as exc:  # noqa: BLE001
-            errors[h] = exc
+    return run_hybrid_world(fn_for, hosts=hosts, local=local,
+                            timeout=timeout)
 
-    threads = [threading.Thread(target=host_main, args=(h,), daemon=True)
-               for h in range(hosts)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=timeout)
-        if t.is_alive():
-            raise TimeoutError("hybrid host thread hung")
-    for e in errors:
-        if e is not None:
-            raise e
-    flat = [None] * (hosts * local)
-    for h in range(hosts):
-        for l in range(local):
-            flat[h * local + l] = results[h][l]
-    return flat
 
 
 def test_rank_size_topology():

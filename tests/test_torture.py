@@ -143,3 +143,36 @@ def test_backends_agree_on_random_schedule(seed):
             f"mismatch at step "
             f"{next(i for i, (a, b) in enumerate(zip(xla_logs[r], tcp_logs[r])) if a != b)}"
         )
+
+
+@pytest.mark.parametrize("seed", [23])
+def test_hybrid_agrees_with_tcp_on_random_schedule(seed):
+    """The same schedule over the hybrid driver (2 hosts x N/2 local
+    ranks): hierarchical engines, cross-host rings and composed tags
+    must reproduce the tcp driver's log exactly."""
+    from conftest import run_hybrid_world
+
+    hosts, local = 2, N // 2
+    assert hosts * local == N  # keep the comparison loop honest
+
+    def fn_for(net):
+        def main():
+            net.init()
+            w = comm_world(net)
+            out = _run_schedule(w, w.rank(), seed)
+            net.finalize()
+            return out
+
+        return main
+
+    hybrid_logs = run_hybrid_world(fn_for, hosts=hosts, local=local,
+                                   timeout=180.0)
+
+    with tcp_cluster(N) as tnets:
+        tcp_logs = run_on_ranks(
+            tnets, lambda net, r: _run_schedule(comm_world(net), r, seed),
+            timeout=120.0)
+
+    for r in range(N):
+        assert hybrid_logs[r] == tcp_logs[r], (
+            f"hybrid/tcp divergence at rank {r} (seed {seed})")
