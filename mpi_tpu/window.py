@@ -2,8 +2,9 @@
 
 The last MPI pillar the facade lacked: every rank exposes a local array
 (the *window*), and peers read/write it with :meth:`Window.put` /
-:meth:`Window.get` / :meth:`Window.accumulate` without the target
-issuing a matching call. Synchronization is **active-target fence
+:meth:`Window.get` / :meth:`Window.accumulate` /
+:meth:`Window.get_accumulate` / :meth:`Window.fetch_and_op` without the
+target issuing a matching call. Synchronization is **active-target fence
 epochs** (MPI_Win_fence): RMA calls issued between two fences are
 queued locally and complete collectively at the closing fence —
 exactly MPI's "all operations complete at the fence" contract.
@@ -40,8 +41,9 @@ __all__ = ["Window", "win_create"]
 
 
 class RmaHandle:
-    """Result handle for :meth:`Window.get`: the data is defined once
-    the closing :meth:`Window.fence` has run."""
+    """Result handle for :meth:`Window.get` / :meth:`Window.get_accumulate`
+    / :meth:`Window.fetch_and_op`: the data (fetched span or pre-value)
+    is defined once the closing :meth:`Window.fence` has run."""
 
     __slots__ = ("_value", "_ready")
 
@@ -53,7 +55,7 @@ class RmaHandle:
     def array(self) -> np.ndarray:
         if not self._ready:
             raise MpiError(
-                "mpi_tpu: RMA get result read before the closing fence()")
+                "mpi_tpu: RMA result read before the closing fence()")
         return self._value
 
 
@@ -70,7 +72,10 @@ class Window:
         self._comm = comm
         self._local = local
         self._lock = threading.Lock()
-        self._puts: List[Tuple[int, int, np.ndarray, Optional[OpLike]]] = []
+        # (target, offset, payload, op, fetch_handle): op None = put;
+        # a non-None handle makes it a get_accumulate (pre-value read).
+        self._puts: List[Tuple[int, int, np.ndarray, Optional[OpLike],
+                               Optional[RmaHandle]]] = []
         self._gets: List[Tuple[int, int, int, RmaHandle]] = []
         self._epoch = 0
         # Collective sanity: every member must expose the same dtype (and
@@ -109,31 +114,14 @@ class Window:
 
     # -- origin-side operations (queued until the closing fence) -----------
 
-    def _queue(self, data: Any, target: int, offset: int,
-               op: Optional[OpLike]) -> None:
-        """Shared put/accumulate path: snapshot the payload ONCE (the
-        caller may reuse its buffer immediately), validate the span,
-        queue the record for the closing fence."""
-        arr = np.array(data, dtype=self._local.dtype, copy=True).reshape(-1)
-        self._check_span(target, offset, arr.shape[0])
-        with self._lock:
-            self._puts.append((target, int(offset), arr, op))
-
-    def put(self, data: Any, target: int, offset: int = 0) -> None:
-        """Write ``data`` into ``target``'s window at ``offset``
-        (MPI_Put). Completes at the closing fence; the origin buffer is
-        snapshotted now, so the caller may reuse it immediately."""
-        self._queue(data, target, offset, None)
-
-    def accumulate(self, data: Any, target: int, offset: int = 0,
-                   op: OpLike = "sum") -> None:
-        """Combine ``data`` into ``target``'s window (MPI_Accumulate):
-        ``window[span] = op(window[span], data)``, applied in
-        (source rank, issue order) at the closing fence. Callable ops
-        must be picklable (module-level functions, not lambdas): the
-        record crosses process boundaries on the tcp/hybrid drivers, and
-        the check runs here — identically on every driver — so a bad op
-        fails at issue time instead of desyncing the collective fence."""
+    @staticmethod
+    def _check_acc_op(op: OpLike) -> None:
+        """Shared accumulate/get_accumulate op validation. Callable ops
+        must additionally be picklable (module-level functions, not
+        lambdas): the record crosses process boundaries on the
+        tcp/hybrid drivers, and the check runs at issue time —
+        identically on every driver — so a bad op fails here instead of
+        desyncing the collective fence."""
         from .collectives_generic import check_op
 
         check_op(op)
@@ -148,7 +136,57 @@ class Window:
                     "(a module-level function, not a lambda/closure) — "
                     f"they cross process boundaries at fence(): {exc}"
                 ) from exc
+
+    def _queue(self, data: Any, target: int, offset: int,
+               op: Optional[OpLike],
+               handle: Optional[RmaHandle] = None) -> None:
+        """Shared put/accumulate/get_accumulate path: snapshot the
+        payload ONCE (the caller may reuse its buffer immediately),
+        validate the span, queue the record for the closing fence."""
+        arr = np.array(data, dtype=self._local.dtype, copy=True).reshape(-1)
+        self._check_span(target, offset, arr.shape[0])
+        with self._lock:
+            self._puts.append((target, int(offset), arr, op, handle))
+
+    def put(self, data: Any, target: int, offset: int = 0) -> None:
+        """Write ``data`` into ``target``'s window at ``offset``
+        (MPI_Put). Completes at the closing fence; the origin buffer is
+        snapshotted now, so the caller may reuse it immediately."""
+        self._queue(data, target, offset, None)
+
+    def accumulate(self, data: Any, target: int, offset: int = 0,
+                   op: OpLike = "sum") -> None:
+        """Combine ``data`` into ``target``'s window (MPI_Accumulate):
+        ``window[span] = op(window[span], data)``, applied in
+        (source rank, issue order) at the closing fence."""
+        self._check_acc_op(op)
         self._queue(data, target, offset, op)
+
+    def get_accumulate(self, data: Any, target: int, offset: int = 0,
+                       op: OpLike = "sum") -> RmaHandle:
+        """Atomically read-then-combine (MPI_Get_accumulate): at the
+        closing fence the target span's PRE-combination value is
+        captured for this origin, then ``op(window[span], data)`` is
+        applied — all in the deterministic (source rank, issue order),
+        so e.g. a fetch-and-add counter hands every rank a distinct
+        ticket. Returns a handle whose ``.array`` (the pre-value) is
+        defined after the fence."""
+        self._check_acc_op(op)
+        handle = RmaHandle()
+        self._queue(data, target, offset, op, handle)
+        return handle
+
+    def fetch_and_op(self, value: Any, target: int, offset: int = 0,
+                     op: OpLike = "sum") -> RmaHandle:
+        """Single-element :meth:`get_accumulate` (MPI_Fetch_and_op) —
+        the distributed-counter primitive; ``handle.array[0]`` is this
+        rank's pre-value after the fence."""
+        arr = np.asarray(value, dtype=self._local.dtype)
+        if arr.size != 1:
+            raise MpiError(
+                f"mpi_tpu: fetch_and_op takes a single element, got "
+                f"shape {arr.shape}; use get_accumulate for spans")
+        return self.get_accumulate(arr.reshape(1), target, offset, op=op)
 
     def get(self, target: int, offset: int = 0,
             count: Optional[int] = None) -> RmaHandle:
@@ -178,14 +216,24 @@ class Window:
             puts, self._puts = self._puts, []
             gets, self._gets = self._gets, []
 
-        # Round 1: deliver put/accumulate records to their targets.
+        # Round 1: deliver put/accumulate records to their targets (the
+        # fetch flag asks the target to capture the span's PRE-value for
+        # this origin before combining — MPI_Get_accumulate).
         outbound: List[List[Tuple]] = [[] for _ in range(n)]
-        for target, offset, arr, op in puts:
-            outbound[target].append((offset, arr, op))
+        fetch_handles: List[List[RmaHandle]] = [[] for _ in range(n)]
+        for target, offset, arr, op, handle in puts:
+            outbound[target].append((offset, arr, op, handle is not None))
+            if handle is not None:
+                fetch_handles[target].append(handle)
         inbound = self._comm.alltoall(outbound)
-        for records in inbound:  # source-rank order; issue order within
-            for offset, arr, op in records:
+        pres: List[List[np.ndarray]] = [[] for _ in range(n)]
+        for source, records in enumerate(inbound):
+            # source-rank order; issue order within — the deterministic
+            # application order the module doc promises.
+            for offset, arr, op, fetch in records:
                 span = slice(offset, offset + arr.shape[0])
+                if fetch:
+                    pres[source].append(self._local[span].copy())
                 if op is None:
                     self._local[span] = arr
                 else:
@@ -193,20 +241,25 @@ class Window:
                         combine(self._local[span], arr, op),
                         dtype=self._local.dtype)
 
-        # Round 2: exchange get requests, then serve them from the
-        # post-put window state.
+        # Round 2: exchange get requests; serve them (and return the
+        # captured pre-values) from the post-put window state.
         requests: List[List[Tuple[int, int]]] = [[] for _ in range(n)]
         for target, offset, count, _ in gets:
             requests[target].append((offset, count))
         incoming = self._comm.alltoall(requests)
         replies = [
-            [self._local[o:o + c].copy() for (o, c) in reqs]
-            for reqs in incoming
+            (pres[peer], [self._local[o:o + c].copy()
+                          for (o, c) in reqs])
+            for peer, reqs in enumerate(incoming)
         ]
         answered = self._comm.alltoall(replies)
+        for target, (pre_vals, _) in enumerate(answered):
+            for handle, pre in zip(fetch_handles[target], pre_vals):
+                handle._value = np.asarray(pre)
+                handle._ready = True
         cursor = [0] * n
         for target, _, _, handle in gets:  # issue order per target
-            handle._value = np.asarray(answered[target][cursor[target]])
+            handle._value = np.asarray(answered[target][1][cursor[target]])
             handle._ready = True
             cursor[target] += 1
         self._epoch += 1

@@ -42,7 +42,7 @@ def _schedule(seed: int):
             "allreduce", "bcast", "allgather", "scan", "exscan",
             "reduce_scatter", "sendrecv_ring", "barrier", "alltoall",
             "gather_scatter", "group_allreduce", "iallreduce",
-            "rma_epoch", "probe_pass",
+            "rma_epoch", "probe_pass", "fetch_ticket",
         ])
         ops.append((kind, int(rng.integers(0, 1 << 30)),
                     int(rng.integers(0, N)),
@@ -106,6 +106,10 @@ def _run_schedule(comm, rank: int, seed: int):
             h = win.get(root, count=n)
             win.fence()
             log.append([int(x) for x in h.array])
+        elif kind == "fetch_ticket":
+            h = win.fetch_and_op(np.int64(rank + 1), root)
+            win.fence()
+            log.append(int(h.array[0]))
         elif kind == "probe_pass":
             tag = 200 + step
             if rank == 0:

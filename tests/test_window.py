@@ -182,6 +182,82 @@ class TestAccumulate:
         assert all(o == (3.0, 103.0, 4) for o in out)
 
 
+class TestFetchAndOp:
+    def test_ticket_counter(self):
+        """The classic fetch-and-add counter: deterministic source-order
+        application hands every rank a distinct, predictable ticket
+        (its rank-prefix sum) — MPI_Fetch_and_op's signature use."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            win = mpi_tpu.win_create(w, np.zeros(1, np.int64))
+            h = win.fetch_and_op(np.int64(r + 1), 0)
+            win.fence()
+            mpi_tpu.finalize()
+            return int(h.array[0]), int(win.local[0])
+
+        out = spmd(main)
+        # pre-values are prefix sums of (1, 2, 3, 4) in source order
+        assert [o[0] for o in out] == [0, 1, 3, 6]
+        assert out[0][1] == 10  # counter's final value on rank 0
+
+    def test_fetch_and_op_rejects_spans(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            win = mpi_tpu.win_create(w, np.zeros(4, np.int64))
+            try:
+                with pytest.raises(mpi_tpu.MpiError, match="single"):
+                    win.fetch_and_op(np.int64([1, 2]), 0)
+            finally:
+                win.fence()
+                mpi_tpu.finalize()
+
+        spmd(main, n=2)
+
+    def test_get_accumulate_span_pre_values(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            win = mpi_tpu.win_create(w, np.arange(3, dtype=np.float64))
+            h = win.get_accumulate(np.full(2, 10.0 * (r + 1)), 1,
+                                   offset=1, op="sum")
+            win.fence()
+            mpi_tpu.finalize()
+            return h.array.tolist(), win.local.tolist()
+
+        out = spmd(main)
+        # Target rank 1's span [1, 2] starts [1, 2]; each source sees
+        # the prefix of earlier sources' additions.
+        assert out[0][0] == [1.0, 2.0]
+        assert out[1][0] == [11.0, 12.0]
+        assert out[2][0] == [31.0, 32.0]
+        assert out[3][0] == [61.0, 62.0]
+        assert out[1][1] == [0.0, 101.0, 102.0]
+        for r in (0, 2, 3):
+            assert out[r][1] == [0.0, 1.0, 2.0]
+
+    def test_fetch_mixes_with_puts_and_gets(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            win = mpi_tpu.win_create(w, np.zeros(2, np.int64))
+            if r == 2:
+                win.put(np.int64([100]), 0, offset=1)
+            h = win.fetch_and_op(np.int64(1), 0)
+            g = win.get(0, count=2)
+            win.fence()
+            mpi_tpu.finalize()
+            return int(h.array[0]), [int(x) for x in g.array]
+
+        out = spmd(main)
+        assert [o[0] for o in out] == [0, 1, 2, 3]
+        assert all(o[1] == [4, 100] for o in out)
+
+
 class TestLifecycle:
     def test_free_with_pending_rma_raises(self):
         def main():
