@@ -625,6 +625,10 @@ class XlaNetwork:
     device mesh. Construct with the rank count (defaults to every visible
     device) and hand user code to :func:`run_spmd`."""
 
+    # Rank threads share this process's address space, so RMA windows
+    # over this driver support MPI_Win_shared_query (mpi_tpu.window).
+    SUPPORTS_SHARED_WINDOWS = True
+
     def __init__(self, n: Optional[int] = None,
                  devices: Optional[Sequence[Any]] = None,
                  deterministic_collectives: bool = False,

@@ -80,13 +80,33 @@ class Window:
         self._epoch = 0
         # Collective sanity: every member must expose the same dtype (and
         # learn each peer's extent so origin-side bounds checks work).
-        metas = comm.allgather((int(local.shape[0]), str(local.dtype)))
+        # One collective allgather carries extent/dtype metadata — and,
+        # on drivers whose members share ONE address space (the xla
+        # driver's thread-per-rank model; MPI's "unified" memory model),
+        # the actual array object: the object-payload allgather passes
+        # references in-process, so shared_query() hands out the peer's
+        # real buffer, zero-copy (MPI_Win_allocate_shared semantics).
+        # Cross-process drivers ship None instead of copying the window
+        # contents over the wire.
+        shared_ok = bool(getattr(comm._impl, "SUPPORTS_SHARED_WINDOWS",
+                                 False))
+        metas = comm.allgather((int(local.shape[0]), str(local.dtype),
+                                local if shared_ok else None))
         self._extents = [int(m[0]) for m in metas]
         dtypes = {m[1] for m in metas}
         if len(dtypes) != 1:
             raise MpiError(
                 f"mpi_tpu: window dtype must agree across ranks, got "
                 f"{sorted(dtypes)}")
+        entries = [m[2] for m in metas]
+        # The zero-copy contract is verified by IDENTITY: if the driver
+        # delivered a copy of our own buffer (or anything else), shared
+        # windows are silently broken — disable them instead.
+        if shared_ok and entries[comm.rank()] is local \
+                and all(isinstance(e, np.ndarray) for e in entries):
+            self._shared: Optional[List[np.ndarray]] = entries
+        else:
+            self._shared = None
 
     # -- identity ----------------------------------------------------------
 
@@ -264,6 +284,21 @@ class Window:
             cursor[target] += 1
         self._epoch += 1
 
+    def shared_query(self, rank: int) -> np.ndarray:
+        """Direct reference to ``rank``'s window memory
+        (MPI_Win_shared_query) — only when the communicator's members
+        share one address space (the xla driver's thread-per-rank
+        model). Loads/stores through it are immediately visible to the
+        owner with no fence (MPI's unified-memory model within a
+        process); the caller owns the data-race discipline, exactly as
+        with MPI shared windows. Raises on cross-process drivers."""
+        self._comm._check_peer(rank)
+        if self._shared is None:
+            raise MpiError(
+                "mpi_tpu: window memory is not in a shared address space "
+                "on this driver; use put/get/accumulate with fences")
+        return self._shared[rank]
+
     def free(self) -> None:
         """Release the window (MPI_Win_free). Collective by convention;
         pending (un-fenced) RMA is an error."""
@@ -271,6 +306,9 @@ class Window:
             if self._puts or self._gets:
                 raise MpiError(
                     "mpi_tpu: Window.free() with un-fenced RMA pending")
+            # Release peers' buffers and invalidate shared_query: a
+            # freed window must not pin (or keep handing out) memory.
+            self._shared = None
 
 
 def win_create(comm: Comm, local: Any) -> Window:

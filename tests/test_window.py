@@ -307,6 +307,44 @@ class TestLifecycle:
             assert got_len == ((r + 1) % N) + 1
 
 
+class TestSharedWindows:
+    def test_shared_query_zero_copy_on_xla(self):
+        """xla rank threads share one address space: shared_query hands
+        out the peer's REAL buffer — a store is visible to the owner
+        after a barrier, no fence needed (MPI unified memory model)."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            win = mpi_tpu.win_create(w, np.zeros(2, np.float64))
+            peer_mem = win.shared_query((r + 1) % n)
+            peer_mem[0] = float(r + 100)  # direct store into the peer
+            w.barrier()
+            seen = float(win.local[0])    # written by my minus neighbor
+            # It IS the same object for my own rank.
+            same = win.shared_query(r) is win.local
+            mpi_tpu.finalize()
+            return seen, same
+
+        out = spmd(main)
+        for r in range(N):
+            seen, same = out[r]
+            assert seen == float((r - 1) % N + 100)
+            assert same
+
+    def test_shared_query_raises_cross_process(self):
+        with tcp_cluster(2) as nets:
+            def body(net, r):
+                win = mpi_tpu.win_create(comm_world(net),
+                                         np.zeros(1, np.float32))
+                with pytest.raises(mpi_tpu.MpiError, match="shared"):
+                    win.shared_query(1 - r)
+                win.fence()
+                return True
+
+            assert run_on_ranks(nets, body) == [True, True]
+
+
 class TestTcpDriver:
     def test_rma_over_tcp_cluster(self):
         with tcp_cluster(3) as nets:
