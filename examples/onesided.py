@@ -1,0 +1,60 @@
+"""onesided — RMA windows in action (MPI_Win put/get/fetch_and_op).
+
+No reference analogue (btracey/mpi is two-sided only); this demos the
+framework's one-sided pillar with the two canonical patterns:
+
+  * a **fetch-and-add ticket counter** on rank 0: every rank draws a
+    ticket without rank 0 doing anything — and because this framework
+    applies RMA deterministically in (source rank, issue order), the
+    tickets are reproducible prefix sums rather than a race;
+  * a **bulletin board**: every rank puts its contribution into a slot
+    of rank 0's window, then everyone gets the full board after the
+    fence.
+
+Run::
+
+    python -m mpi_tpu.launch.mpirun 4 examples/onesided.py
+    python examples/onesided.py --mpi-backend xla --mpi-ranks 8
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mpi_tpu
+
+
+def main() -> None:
+    mpi_tpu.init()
+    try:
+        world = mpi_tpu.comm_world()
+        rank, size = world.rank(), world.size()
+
+        # Window layout on every rank: [counter, board slots...].
+        win = mpi_tpu.win_create(world, np.zeros(1 + size, np.int64))
+
+        # One epoch does it all: draw a ticket from rank 0's counter,
+        # post to rank 0's board, read the whole window back.
+        ticket_h = win.fetch_and_op(np.int64(1), 0, offset=0)
+        win.put(np.int64([rank * 11]), 0, offset=1 + rank)
+        board_h = win.get(0)
+        win.fence()
+
+        ticket = int(ticket_h.array[0])
+        board = [int(x) for x in board_h.array[1:]]
+        if ticket != rank:  # source-order prefix sum of ones == rank
+            raise SystemExit(f"rank {rank}: ticket {ticket} != {rank}")
+        if board != [r * 11 for r in range(size)]:
+            raise SystemExit(f"rank {rank}: board mismatch: {board}")
+        print(f"rank {rank}: ticket {ticket}, board {board}", flush=True)
+
+        win.free()
+    finally:
+        mpi_tpu.finalize()
+
+
+if __name__ == "__main__":
+    mpi_tpu.run_main(main)

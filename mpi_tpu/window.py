@@ -88,6 +88,7 @@ class Window:
         # real buffer, zero-copy (MPI_Win_allocate_shared semantics).
         # Cross-process drivers ship None instead of copying the window
         # contents over the wire.
+        self._freed = False
         shared_ok = bool(getattr(comm._impl, "SUPPORTS_SHARED_WINDOWS",
                                  False))
         metas = comm.allgather((int(local.shape[0]), str(local.dtype),
@@ -293,6 +294,8 @@ class Window:
         process); the caller owns the data-race discipline, exactly as
         with MPI shared windows. Raises on cross-process drivers."""
         self._comm._check_peer(rank)
+        if self._freed:
+            raise MpiError("mpi_tpu: shared_query() on a freed window")
         if self._shared is None:
             raise MpiError(
                 "mpi_tpu: window memory is not in a shared address space "
@@ -309,6 +312,7 @@ class Window:
             # Release peers' buffers and invalidate shared_query: a
             # freed window must not pin (or keep handing out) memory.
             self._shared = None
+            self._freed = True
 
 
 def win_create(comm: Comm, local: Any) -> Window:

@@ -54,6 +54,15 @@ class TestStencil:
 
 
 @pytest.mark.integration
+class TestOnesided:
+    def test_tickets_and_board_4_ranks(self):
+        res = _mpirun(4, "examples/onesided.py")
+        assert res.returncode == 0, res.stderr
+        # Each rank self-verifies (exit!=0 on mismatch); spot-check one.
+        assert "rank 3: ticket 3, board [0, 11, 22, 33]" in res.stdout
+
+
+@pytest.mark.integration
 class TestCommGroups:
     def test_2x2_grid(self):
         res = _mpirun(4, "examples/comm_groups.py")
