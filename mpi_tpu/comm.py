@@ -63,6 +63,12 @@ USER_TAG_SPAN = 1 << 40   # user tags within a region: [0, 2^40)
 # user tag can alias a halo message) and fenced off from the generic
 # collectives' growing sequence by _map_tag's exhaustion check.
 _NEIGHBOR_SLICE = 1 << 20
+# Context numbering: negotiated contexts grow monotonically from 1 and
+# can never plausibly reach the top of the space, so the topmost
+# _CREATE_GROUP_TAGS contexts are reserved as create_group's bootstrap
+# band (one per bootstrap tag).
+_CTX_MAX = (1 << 62) // CTX_SPAN
+_CREATE_GROUP_TAGS = 1 << 12
 
 _ctx_lock = threading.Lock()
 
@@ -118,7 +124,8 @@ class Comm:
     driver to be initialized (``mpi_tpu.init()``).
     """
 
-    def __init__(self, impl: Interface, members: Tuple[int, ...], ctx: int):
+    def __init__(self, impl: Interface, members: Tuple[int, ...], ctx: int,
+                 _ephemeral_tags: bool = False):
         if ctx < 0:
             raise MpiError(f"mpi_tpu: negative comm context {ctx}")
         if len(set(members)) != len(members):
@@ -128,6 +135,14 @@ class Comm:
         self._members = tuple(int(m) for m in members)
         self._ctx = int(ctx)
         self._world_to_group = {w: g for g, w in enumerate(self._members)}
+        # Ephemeral tag state (create_group bootstraps): an instance-
+        # local collective tag sequence restarting at 0, instead of the
+        # persistent per-(rank, ctx) state — bootstrap contexts are
+        # REUSED across calls with varying member sets, and a persistent
+        # sequence would desynchronize ranks whose participation
+        # histories differ (sequential same-tag bootstraps would hang).
+        self._ephemeral_coll_state = _CollState() if _ephemeral_tags \
+            else None
 
     # -- identity ----------------------------------------------------------
 
@@ -333,6 +348,8 @@ class Comm:
     # -- collective tag-sequence state (see _CollState) --------------------
 
     def _coll_state(self) -> _CollState:
+        if self._ephemeral_coll_state is not None:
+            return self._ephemeral_coll_state
         key = (self._impl.rank(), self._ctx)
         with _ctx_lock:
             states = self._impl.__dict__.setdefault("_comm_coll_states", {})
@@ -549,6 +566,56 @@ class Comm:
         child = self.split(color=0, key=self.rank())
         assert child is not None
         return child
+
+    def create_group(self, members, tag: int = 0) -> "Comm":
+        """Create a communicator from an explicit subset of this comm's
+        ranks (MPI_Comm_create_group): collective among ``members``
+        ONLY — non-members do not participate at all, which is the
+        point (vs :meth:`split`, where every rank must call). Group
+        ranks follow the order of ``members``.
+
+        ``tag`` disambiguates the bootstrap exactly as in MPI:
+        concurrent ``create_group`` calls whose groups OVERLAP must use
+        distinct tags (disjoint groups may share one) — here that rule
+        spans parent communicators, slightly stricter than MPI's
+        per-communicator tag scope. ``tag`` must be in ``[0, 4096)``.
+        The caller must be listed in ``members``. Sequential calls may
+        freely reuse a tag (each bootstrap's tag sequence is
+        instance-local)."""
+        members = tuple(int(m) for m in members)
+        for m in members:
+            self._check_peer(m)
+        if len(set(members)) != len(members):
+            raise MpiError(
+                f"mpi_tpu: duplicate ranks in create_group members "
+                f"{members}")
+        if not 0 <= tag < _CREATE_GROUP_TAGS:
+            raise MpiError(
+                f"mpi_tpu: create_group tag must be in [0, "
+                f"{_CREATE_GROUP_TAGS}), got {tag}")
+        me = self.rank()
+        if me not in members:
+            raise MpiError(
+                f"mpi_tpu: create_group caller (group rank {me}) is not "
+                f"in members {members} — only members may call "
+                f"(MPI_Comm_create_group contract)")
+        # Bootstrap: a temporary communicator in a reserved context band
+        # at the top of the context space, keyed by the user tag, runs
+        # the standard ctx negotiation among the members only. Tag-
+        # disambiguation makes overlapping concurrent bootstraps safe,
+        # per the MPI contract above; negotiated contexts are monotone
+        # small integers and cannot reach the band.
+        world_members = tuple(self._members[m] for m in members)
+        boot = Comm(self._impl, world_members, _CTX_MAX - 1 - tag,
+                    _ephemeral_tags=True)
+        try:
+            bid = _propose_ctx(self._impl)
+            bids = boot.allgather(bid)
+            new_ctx = max(int(b) for b in bids)
+            _raise_ctx_high(self._impl, new_ctx)
+        finally:
+            boot.free()  # release bootstrap engines/buffers
+        return Comm(self._impl, world_members, new_ctx)
 
     def free(self) -> None:
         """Release driver resources held for this communicator —

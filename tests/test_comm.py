@@ -182,6 +182,96 @@ class TestSplit:
 
         spmd(main, n=2)
 
+    def test_create_group_members_only(self):
+        """MPI_Comm_create_group: only the listed members participate —
+        the other ranks are busy doing unrelated p2p at the same time,
+        which a split (all-ranks collective) could never allow."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            if r < 2:
+                sub = w.create_group((1, 0), tag=3)  # explicit order
+                total = float(sub.allreduce(np.float32(r + 1)))
+                res = (sub.members, sub.rank(), total)
+            else:
+                # Non-members never touch create_group; they exchange
+                # p2p traffic concurrently instead.
+                peer = 5 - r  # 2<->3
+                res = w.sendrecv(f"busy-{r}", dest=peer, source=peer,
+                                 tag=9)
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main, n=4)
+        assert out[0] == ((1, 0), 1, 3.0)
+        assert out[1] == ((1, 0), 0, 3.0)
+        assert out[2] == "busy-3" and out[3] == "busy-2"
+
+    def test_create_group_caller_must_be_member(self):
+        def main():
+            mpi_tpu.init()
+            try:
+                w = comm_world()
+                if w.rank() == 0:
+                    with pytest.raises(mpi_tpu.MpiError,
+                                       match="only members"):
+                        w.create_group((1,), tag=1)
+            finally:
+                mpi_tpu.finalize()
+
+        spmd(main, n=2)
+
+    def test_sequential_create_group_reuses_tag(self):
+        """Sequential bootstraps may reuse the default tag even with
+        DIFFERENT member sets: each bootstrap's tag sequence is
+        instance-local, so varying participation histories cannot
+        desynchronize it (a persistent sequence would hang here)."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            res = []
+            if r in (0, 1):
+                a = w.create_group((0, 1))
+                res.append(float(a.allreduce(np.float32(1.0))))
+            w.barrier()
+            if r in (0, 2):  # same default tag, different members
+                b = w.create_group((0, 2))
+                res.append(float(b.allreduce(np.float32(5.0))))
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main, n=3)
+        assert out[0] == [2.0, 10.0]
+        assert out[1] == [2.0]
+        assert out[2] == [10.0]
+
+    def test_concurrent_create_groups_distinct_tags(self):
+        """Two overlapping groups bootstrapping CONCURRENTLY from
+        different member sets — legal with distinct tags (the MPI
+        contract this method inherits)."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            # Group A = (0, 1, 2) tag 5; group B = (2, 3) tag 6 —
+            # overlap at rank 2, which joins both sequentially; ranks
+            # 0/1 and 3 enter their bootstraps at the same time.
+            res = []
+            if r in (0, 1, 2):
+                a = w.create_group((0, 1, 2), tag=5)
+                res.append(float(a.allreduce(np.float32(1.0))))
+            if r in (2, 3):
+                b = w.create_group((2, 3), tag=6)
+                res.append(float(b.allreduce(np.float32(10.0))))
+            mpi_tpu.finalize()
+            return res
+
+        out = spmd(main, n=4)
+        assert out[0] == [3.0] and out[1] == [3.0]
+        assert out[2] == [3.0, 20.0] and out[3] == [20.0]
+
     def test_dup_same_members_fresh_ctx(self):
         def main():
             mpi_tpu.init()
