@@ -4,10 +4,15 @@ The reference has exactly one implicit communicator: the whole world
 (``Rank()``/``Size()`` address every process, mpi.go:112-119; every
 ``Send``/``Receive`` peer is a world rank, mpi.go:126-159). This module is
 framework-completeness work with no reference analogue: it supplies the
-``MPI_Comm_split`` / ``MPI_Comm_dup`` surface an MPI user expects —
-ordered sub-groups with their own dense rank numbering, their own
-collectives, and *context isolation* so traffic on one communicator can
-never be captured by a matching ``{peer, tag}`` pair on another.
+communicator surface an MPI user expects — ``split`` /
+``split_type("host")`` / ``dup`` / ``create_group`` / ``free`` for
+construction, group-translated p2p (blocking, nonblocking, persistent,
+probe), the full collective suite (blocking and MPI-3 I-variants),
+and Cartesian topologies (:class:`CartComm`: coords/shift/sub plus
+neighborhood collectives) — ordered sub-groups with their own dense
+rank numbering and *context isolation* so traffic on one communicator
+can never be captured by a matching ``{peer, tag}`` pair on another.
+One-sided windows build on top in :mod:`mpi_tpu.window`.
 
 Design (tpu-first, but transport-agnostic):
 
