@@ -517,12 +517,16 @@ def _bounce_tcp_child() -> int:
     return 0
 
 
-def bounce_tcp() -> float:
-    """Mean round-trip µs for the TCP driver, 2 real processes over
-    loopback — the reference's own transport method
-    (bounce.go:85-112), re-measured every run so the headline's
-    comparison can never go stale (VERDICT round-1 item 8)."""
+def bounce_tcp(proto: str = "tcp", port_base: int = 6200) -> float:
+    """Mean round-trip µs for the socket driver, 2 real processes —
+    the reference's own transport method (bounce.go:85-112),
+    re-measured every run so the headline's comparison can never go
+    stale (VERDICT round-1 item 8). ``proto="shm"`` runs the identical
+    two-process ping-pong over the native shared-memory rings instead
+    of loopback TCP (the launcher's port-derived addresses become
+    opaque ring ids)."""
     import tempfile
+    import uuid
 
     from mpi_tpu.launch.mpirun import launch
 
@@ -532,10 +536,17 @@ def bounce_tcp() -> float:
         # Children never touch the accelerator — keep them off the chip
         # the parent is benchmarking.
         env["JAX_PLATFORMS"] = "cpu"
-        rc = launch(2, os.path.abspath(__file__), ["--_bounce-child"],
-                    port_base=6200, timeout=30.0, env=env)
+        args = ["--_bounce-child"]
+        kwargs = {}
+        if proto != "tcp":
+            args += ["--mpi-protocol", proto]
+            # Unique password → unique shm session key: concurrent
+            # bench/test runs on one box can't collide on ring names.
+            kwargs["password"] = uuid.uuid4().hex
+        rc = launch(2, os.path.abspath(__file__), args,
+                    port_base=port_base, timeout=30.0, env=env, **kwargs)
         if rc != 0:
-            raise RuntimeError(f"tcp bounce children failed rc={rc}")
+            raise RuntimeError(f"{proto} bounce children failed rc={rc}")
         return float(f.read() or "nan")
 
 
@@ -629,12 +640,23 @@ def main() -> int:
     # Every completed leg lands in _PARTIALS immediately, so the
     # watchdog's error line carries whatever finished before a hang.
     tcp_us = bounce_tcp()
+    try:
+        shm_us = bounce_tcp(proto="shm", port_base=6300)
+    except Exception as exc:  # noqa: BLE001 - leg optional, never fatal
+        shm_us = None
+        print(f"bench: shm bounce leg failed: {exc}", file=sys.stderr)
     xla_us = bounce_xla()
     bounce_keys = {
         "bounce_tcp_us": round(tcp_us, 1),
         "bounce_xla_us": round(xla_us, 1),
         "bounce_speedup": round(tcp_us / xla_us, 1),
     }
+    if shm_us is not None:
+        # Same two-OS-process ping-pong as the TCP leg, frames riding
+        # the native shared-memory rings: the like-for-like transport
+        # comparison (processes + codec + rendezvous on both sides).
+        bounce_keys["bounce_shm_us"] = round(shm_us, 1)
+        bounce_keys["bounce_shm_speedup_vs_tcp"] = round(tcp_us / shm_us, 1)
     _PARTIALS.update(bounce_keys)
     bounce_keys.update(bounce_device((1 << 14) if smoke else BOUNCE_SIZE))
     _PARTIALS.update(bounce_keys)

@@ -21,6 +21,9 @@ point-to-point library over TCP, /root/reference) designed TPU-first:
 """
 
 from .comm import CartComm, Comm, cart_create, comm_world
+from .distgraph import DistGraphComm, dist_graph_create_adjacent
+from .intercomm import Intercomm, create_intercomm
+from .io import File, open_file
 from .window import Window, win_create
 from .runner import run_main, selected_backend
 from .api import (
@@ -68,6 +71,8 @@ from .api import (
     send,
     sendrecv,
     size,
+    wtime,
+    wtick,
 )
 
 __version__ = "0.1.0"
@@ -125,5 +130,13 @@ __all__ = [
     "send",
     "sendrecv",
     "size",
+    "wtime",
+    "wtick",
+    "Intercomm",
+    "create_intercomm",
+    "DistGraphComm",
+    "dist_graph_create_adjacent",
+    "File",
+    "open_file",
     "__version__",
 ]

@@ -35,6 +35,7 @@ back to generic tree/ring algorithms built on ``send``/``receive``
 from __future__ import annotations
 
 import threading
+import time
 from typing import (TYPE_CHECKING, Any, Callable, List, Optional, Protocol,
                     Tuple, runtime_checkable)
 
@@ -243,6 +244,21 @@ def rank() -> int:
 def size() -> int:
     """Total number of ranks (mpi.go:117-119)."""
     return _require_init().size()
+
+
+def wtime() -> float:
+    """Elapsed wall-clock seconds from an arbitrary fixed origin
+    (MPI_Wtime; no reference analogue — bounce times with Go's
+    ``time.Now``, bounce.go:90-101). Monotonic and per-process: like
+    MPI with MPI_WTIME_IS_GLOBAL false, origins differ across ranks,
+    so difference timestamps taken on ONE rank."""
+    return time.perf_counter()
+
+
+def wtick() -> float:
+    """Resolution of :func:`wtime` in seconds (MPI_Wtick)."""
+    info = time.get_clock_info("perf_counter")
+    return float(info.resolution)
 
 
 def _payload_bytes(data: Any) -> int:

@@ -62,6 +62,7 @@ from ..api import MpiError
 from ..utils.serialize import decode as codec_decode
 from ..utils.serialize import encode as codec_encode
 from .rendezvous import ReceiveCancelled, Rendezvous, TagManager
+from .shm import ShmConn
 
 __all__ = ["TcpNetwork", "InitError", "ReceiveCancelled"]
 
@@ -73,10 +74,12 @@ _FRAME_HDR = struct.Struct("<BqI")
 _DIAL_RETRY_INTERVAL = 0.1  # network.go:298 — 100 ms poll
 
 # The reference's NetProto accepts any `net` package protocol
-# (network.go:26). Supported here: TCP (the default, "tcp4" an alias)
-# and unix-domain stream sockets (addresses = filesystem paths).
+# (network.go:26). Supported here: TCP (the default, "tcp4" an alias),
+# unix-domain stream sockets (addresses = filesystem paths), and "shm"
+# — same-host shared-memory rings via the native engine
+# (backends/shm.py, native/shmcore.cpp; addresses = opaque ids).
 # Anything else raises at init instead of being silently ignored.
-_SUPPORTED_PROTOS = ("tcp", "tcp4", "unix")
+_SUPPORTED_PROTOS = ("tcp", "tcp4", "unix", "shm")
 
 
 class InitError(MpiError):
@@ -91,8 +94,17 @@ def _split_hostport(addr: str) -> Tuple[str, int]:
     return host, int(port)
 
 
-def _send_frame(sock: socket.socket, lock: threading.Lock, kind: int,
+def _send_frame(sock, lock: threading.Lock, kind: int,
                 tag: int, payload: bytes = b"") -> None:
+    if isinstance(sock, ShmConn):
+        # shm conns frame in the ring engine; the per-conn lock still
+        # serializes concurrent senders (the SPSC ring's one-producer
+        # contract).
+        if not isinstance(payload, bytes):
+            payload = bytes(payload)
+        with lock:
+            sock.send_frame(kind, tag, payload)
+        return
     from .. import native as _native
 
     # Python socket timeouts make the fd non-blocking at the OS level;
@@ -162,7 +174,9 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     return buf
 
 
-def _recv_frame(sock: socket.socket) -> Tuple[int, int, bytearray]:
+def _recv_frame(sock) -> Tuple[int, int, bytearray]:
+    if isinstance(sock, ShmConn):
+        return sock.recv_frame()
     kind, tag, length = _FRAME_HDR.unpack(_recv_exact(sock, _FRAME_HDR.size))
     payload = _recv_exact(sock, length) if length else bytearray()
     return kind, tag, payload
@@ -226,8 +240,8 @@ class TcpNetwork:
         collapse to one key; unix-domain sockets are single-machine)."""
         if self.addr is None:
             raise MpiError("mpi_tpu: host_key() before init()")
-        if self.proto == "unix":
-            return "unix"
+        if self.proto in ("unix", "shm"):
+            return self.proto
         host, _, _ = self.addr.rpartition(":")
         host = host.lower()
         return "127.0.0.1" if host in ("", "localhost", "::1", "[::1]") \
@@ -275,6 +289,15 @@ class TcpNetwork:
         for peer in self._peers.values():
             for t in peer.reader_threads:
                 t.join(timeout=2.0)
+        # shm conns unmap only now: their reader threads dereference the
+        # mapping inside native calls, so release must follow the joins
+        # (and is skipped for a reader that refused to die).
+        for peer in self._peers.values():
+            if any(t.is_alive() for t in peer.reader_threads):
+                continue
+            for sock in (peer.dial_sock, peer.listen_sock):
+                if isinstance(sock, ShmConn):
+                    sock.release()
         self._initialized = False
 
     def send(self, data: Any, dest: int, tag: int) -> None:
@@ -341,9 +364,12 @@ class TcpNetwork:
     def _is_unix(self) -> bool:
         return self.proto == "unix"
 
+    def _is_shm(self) -> bool:
+        return self.proto == "shm"
+
     def _tune(self, sock: socket.socket) -> None:
-        """Latency tuning where applicable (no-op for unix sockets)."""
-        if not self._is_unix():
+        """Latency tuning where applicable (TCP only)."""
+        if self.proto in ("tcp", "tcp4"):
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def _use_flags(self) -> None:
@@ -402,6 +428,36 @@ class TcpNetwork:
             with err_lock:
                 errors.append(err)
 
+        if self._is_shm():
+            self._shm_bootstrap(note)
+        else:
+            self._socket_bootstrap(note)
+
+        if not errors:
+            for peer in self._peers.values():
+                if peer.dial_sock is None:
+                    errors.append(f"rank {me}: no dial conn to {peer.rank}")
+                if peer.listen_sock is None:
+                    errors.append(f"rank {me}: no listen conn from {peer.rank}")
+        if errors:
+            self.finalize()
+            raise InitError("; ".join(sorted(set(errors))))
+
+        # Persistent readers (replace per-call goroutines; see module doc).
+        for peer in self._peers.values():
+            t1 = threading.Thread(target=self._dial_reader, args=(peer,),
+                                  name=f"mpi-ackreader-{peer.rank}", daemon=True)
+            t2 = threading.Thread(target=self._listen_reader, args=(peer,),
+                                  name=f"mpi-datareader-{peer.rank}", daemon=True)
+            peer.reader_threads = [t1, t2]
+            t1.start()
+            t2.start()
+
+    def _socket_bootstrap(self, note) -> None:
+        """TCP/unix all-to-all bootstrap: listen + dial handshakes
+        (network.go:122-351). Populates peer dial/listen conns; errors
+        go through ``note`` for aggregation."""
+        n, me = self._size, self._rank
         # Listen side: accept n-1 peers, each validated by handshake.
         if self._is_unix():
             listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -546,25 +602,134 @@ class TcpNetwork:
         for _ in range(n - 1):
             accepted.acquire()
 
-        if not errors:
-            for peer in self._peers.values():
-                if peer.dial_sock is None:
-                    errors.append(f"rank {me}: no dial conn to {peer.rank}")
-                if peer.listen_sock is None:
-                    errors.append(f"rank {me}: no listen conn from {peer.rank}")
-        if errors:
-            self.finalize()
-            raise InitError("; ".join(sorted(set(errors))))
+    def _shm_bootstrap(self, note) -> None:
+        """All-to-all bootstrap over shared-memory rings (proto ``shm``).
 
-        # Persistent readers (replace per-call goroutines; see module doc).
-        for peer in self._peers.values():
-            t1 = threading.Thread(target=self._dial_reader, args=(peer,),
-                                  name=f"mpi-ackreader-{peer.rank}", daemon=True)
-            t2 = threading.Thread(target=self._listen_reader, args=(peer,),
-                                  name=f"mpi-datareader-{peer.rank}", daemon=True)
-            peer.reader_threads = [t1, t2]
-            t1.start()
-            t2.start()
+        Same shape as the socket bootstrap: for conn ``a -> me`` the
+        listen side *creates* the ring pair and validates the dialer's
+        HELLO; the dial side *attaches* with the 100 ms retry loop until
+        the init timeout and validates the reply (network.go:198-263,
+        294-351). The session-keyed ring names are themselves the
+        rendezvous points, so there is no listener socket; a stale ring
+        from a crashed run is unlinked at create time, like the unix
+        bootstrap's stale socket file. HELLO still carries the password
+        and claimed rank for reference parity, though the key already
+        binds both (backends/shm.py module doc)."""
+        from .shm import (attach_ring, create_ring, ring_capacity,
+                          ring_name, session_key)
+
+        n, me = self._size, self._rank
+        key = session_key(self.addrs, self.password)
+        cap = ring_capacity()
+
+        def listen_handshake(peer_rank: int) -> None:
+            names = (ring_name(key, peer_rank, me, "d"),
+                     ring_name(key, peer_rank, me, "r"))
+            conn: Optional[ShmConn] = None
+            rx = tx = None
+            try:
+                rx = create_ring(names[0], cap)   # dialer's frames to me
+                tx = create_ring(names[1], cap)   # my replies out
+                conn = ShmConn(tx, rx, owned_names=names)
+                conn.settimeout(self.timeout)
+                kind, claimed_id, payload = _recv_frame(conn)
+                if kind != KIND_HELLO:
+                    raise InitError(f"expected HELLO, got frame kind {kind}")
+                if payload.decode("utf-8") != self.password:
+                    raise InitError("password mismatch")  # network.go:344-347
+                if claimed_id != peer_rank:
+                    raise InitError(
+                        f"ring pair for rank {peer_rank} got HELLO "
+                        f"claiming rank {claimed_id}")
+                lock = threading.Lock()
+                _send_frame(conn, lock, KIND_HELLO, me,
+                            self.password.encode("utf-8"))
+                conn.settimeout(None)
+                peer = self._peers[peer_rank]
+                peer.listen_sock = conn
+                peer.listen_lock = lock
+            except Exception as exc:  # noqa: BLE001 - aggregated, init fails
+                note(f"rank {me}: shm listen handshake with rank "
+                     f"{peer_rank} failed: {exc}")
+                if conn is not None:
+                    conn.close()
+                    conn.release()  # no reader threads exist yet
+                else:
+                    # Partial creation: close and unlink whatever ring
+                    # exists, or the named /dev/shm object outlives the
+                    # process (POSIX shm survives exit).
+                    from .shm import unlink_ring
+                    for ring in (rx, tx):
+                        if ring is not None:
+                            ring.mark_closed()
+                            ring.close()
+                    for name in names:
+                        unlink_ring(name)
+
+        def dial_handshake(peer_rank: int) -> None:
+            names = (ring_name(key, me, peer_rank, "d"),
+                     ring_name(key, me, peer_rank, "r"))
+            deadline = time.monotonic() + self.timeout
+            tx = rx = None
+            try:
+                while tx is None or rx is None:
+                    if tx is None:
+                        tx = attach_ring(names[0])
+                    if tx is not None and rx is None:
+                        rx = attach_ring(names[1])
+                    if tx is not None and rx is not None:
+                        break
+                    if time.monotonic() >= deadline:
+                        raise InitError("timed out waiting for rings")
+                    time.sleep(_DIAL_RETRY_INTERVAL)
+            except Exception as exc:  # noqa: BLE001 - aggregated, init fails
+                # Route unexpected attach errors (EACCES on a stale
+                # ring, ...) through note() like every other handshake
+                # path, instead of dying silently in the thread.
+                note(f"rank {me}: shm dial to rank {peer_rank} "
+                     f"failed: {exc}")
+                for ring in (tx, rx):
+                    if ring is not None:
+                        ring.close()
+                return
+            conn = ShmConn(tx, rx)  # listener owns/unlinks the names
+            try:
+                # Timeout BEFORE the HELLO send (as the listen side does):
+                # a nearly-full stale ring attached in the unlink/recreate
+                # window would otherwise block the write forever and hang
+                # init past its deadline.
+                conn.settimeout(self.timeout)
+                lock = threading.Lock()
+                _send_frame(conn, lock, KIND_HELLO, me,
+                            self.password.encode("utf-8"))
+                kind, their_id, payload = _recv_frame(conn)
+                if kind != KIND_HELLO:
+                    raise InitError(f"expected HELLO reply, got kind {kind}")
+                if payload.decode("utf-8") != self.password:
+                    raise InitError("password mismatch in reply")
+                if their_id != peer_rank:
+                    raise InitError(
+                        f"dialed rank {peer_rank} but peer claims {their_id}")
+                conn.settimeout(None)
+                peer = self._peers[peer_rank]
+                peer.dial_sock = conn
+                peer.dial_lock = lock
+            except Exception as exc:  # noqa: BLE001
+                note(f"rank {me}: shm dial handshake with rank {peer_rank} "
+                     f"failed: {exc}")
+                conn.close()
+                conn.release()  # no reader threads exist yet
+
+        threads = [threading.Thread(target=listen_handshake, args=(r,),
+                                    daemon=True)
+                   for r in range(n) if r != me]
+        threads += [threading.Thread(target=dial_handshake, args=(r,),
+                                     daemon=True)
+                    for r in range(n) if r != me]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
 
     # -- data path ----------------------------------------------------------
 

@@ -1,0 +1,188 @@
+"""Distributed-graph topology communicators
+(MPI_Dist_graph_create_adjacent + neighborhood collectives).
+
+Completes the topology family next to :class:`~mpi_tpu.comm.CartComm`
+(no reference analogue; btracey/mpi has no topologies). Each rank
+declares only its OWN adjacency — the ranks it receives from
+(``sources``) and sends to (``destinations``) — and the neighborhood
+collectives then move data along exactly those edges: the natural fit
+for irregular sparsity (unstructured meshes, graph neural nets,
+expert-routing tables) where a Cartesian grid would be a lie.
+
+tpu-first note: on the xla driver a :class:`DistGraphComm`'s edges are
+host-visible metadata; regular subsets of them (a ring, a grid) should
+be lowered to `shard_map`+`ppermute` programs via
+:mod:`mpi_tpu.parallel` instead. This class is the *host-side* object
+layer, matching the MPI surface.
+
+Contract (as in MPI): the declared graph must be **consistent** — if
+rank ``a`` lists ``b`` in ``destinations`` ``k`` times, rank ``b`` must
+list ``a`` in ``sources`` ``k`` times. Construction verifies this with
+one alltoall of edge counts and raises on every rank rather than
+deadlocking a later neighborhood collective (the same fail-loud stance
+the driver takes elsewhere). Duplicate edges (multigraph) are allowed,
+up to 64 per directed pair; matching follows declaration order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from .api import MpiError, Request
+from .comm import CTX_SPAN, USER_TAG_SPAN, _NEIGHBOR_SLICE, Comm
+
+__all__ = ["DistGraphComm", "dist_graph_create_adjacent"]
+
+_MAX_DUP_EDGES = 64
+
+
+def dist_graph_create_adjacent(comm: Comm, sources: Sequence[int],
+                               destinations: Sequence[int],
+                               validate: bool = True) -> "DistGraphComm":
+    """Build a distributed-graph communicator over ``comm``'s group.
+
+    Collective: every member calls with its own adjacency (group
+    ranks). ``validate=False`` skips the consistency alltoall (one
+    round) for callers that guarantee it themselves."""
+    # Local validation collects an error instead of raising immediately:
+    # raising BEFORE the collective split would leave every other rank
+    # deadlocked inside it — the fail-loud contract (module doc) needs
+    # all ranks to reach the error exchange.
+    n = comm.size()
+    local_err: Optional[str] = None
+    out_counts = [0] * n
+    in_counts = [0] * n
+    for r in tuple(sources) + tuple(destinations):
+        if not 0 <= r < n:
+            local_err = (f"rank {r} out of range [0, {n}) in adjacency")
+            break
+    if local_err is None:
+        for d in destinations:
+            out_counts[d] += 1
+            if out_counts[d] > _MAX_DUP_EDGES:
+                local_err = (f"more than {_MAX_DUP_EDGES} duplicate "
+                             f"edges to rank {d}")
+                break
+    if local_err is None:
+        for s in sources:
+            in_counts[s] += 1
+            if in_counts[s] > _MAX_DUP_EDGES:
+                local_err = (f"more than {_MAX_DUP_EDGES} duplicate "
+                             f"edges from rank {s}")
+                break
+    # Fresh context, same membership/order (an MPI_Comm_dup with
+    # topology attached). Every rank reaches this collectively.
+    child = comm.split(color=0, key=comm.rank())
+    assert child is not None
+    if local_err is not None:
+        # Zero the (possibly partially accumulated) counts so peers do
+        # not derive phantom mismatches from an erring rank — its real
+        # error travels in the unconditional exchange below.
+        out_counts = [0] * n
+        in_counts = [0] * n
+    errors = [] if local_err is None else [local_err]
+    if validate:
+        # Edge-count handshake: what I claim to send to each rank must
+        # equal what they claim to receive from me, and vice versa.
+        # A rank with a local error contributes zeroed counts; its real
+        # error travels in the unconditional exchange below.
+        their_out_to_me = child.alltoall(list(out_counts))
+        if local_err is None:
+            errors += [
+                f"rank {src}->me declares {cnt} edges, I list "
+                f"{in_counts[src]}"
+                for src, cnt in enumerate(their_out_to_me)
+                if cnt != in_counts[src]]
+    # The error exchange is UNCONDITIONAL (validate=False skips only the
+    # count handshake): every rank participates in the same collectives
+    # whether or not it erred locally, so bad arguments raise everywhere
+    # instead of deadlocking the compliant ranks.
+    peer_errs = child.allgather("; ".join(errors))
+    if any(peer_errs):
+        raise MpiError(
+            "mpi_tpu: inconsistent distributed graph: "
+            + "; ".join(f"rank {r}: {e}"
+                        for r, e in enumerate(peer_errs) if e))
+    return DistGraphComm(child, tuple(sources), tuple(destinations))
+
+
+class DistGraphComm(Comm):
+    """A :class:`Comm` carrying per-rank graph adjacency. Everything a
+    Comm does still works; on top: :attr:`in_neighbors` /
+    :attr:`out_neighbors` introspection (MPI_Dist_graph_neighbors) and
+    edge-wise :meth:`neighbor_allgather` / :meth:`neighbor_alltoall`."""
+
+    def __init__(self, base: Comm, sources: Tuple[int, ...],
+                 destinations: Tuple[int, ...]):
+        super().__init__(base._impl, base.members, base.context)
+        self._sources = sources
+        self._destinations = destinations
+
+    @property
+    def in_neighbors(self) -> Tuple[int, ...]:
+        """Group ranks this rank receives from, in declaration order."""
+        return self._sources
+
+    @property
+    def out_neighbors(self) -> Tuple[int, ...]:
+        """Group ranks this rank sends to, in declaration order."""
+        return self._destinations
+
+    def __repr__(self) -> str:
+        return (f"DistGraphComm(ctx={self._ctx}, size={self.size()}, "
+                f"in={self._sources}, out={self._destinations})")
+
+    def _edge_tag(self, tag: int, occurrence: int) -> int:
+        """Synthetic tag in the context's reserved neighborhood slice
+        (same arithmetic as CartComm._neighbor_tag; a DistGraphComm
+        owns its context, so the slice is all ours). ``occurrence``
+        disambiguates duplicate edges on one directed pair — distinct
+        pairs may share a tag safely (collision needs a shared link)."""
+        from .collectives_generic import COLL_TAG_BASE
+
+        if not 0 <= tag < (1 << 13):
+            raise MpiError(
+                f"mpi_tpu: neighbor collective tag must be in [0, 8192), "
+                f"got {tag}")
+        assert occurrence < _MAX_DUP_EDGES
+        return COLL_TAG_BASE + (CTX_SPAN - USER_TAG_SPAN
+                                - _NEIGHBOR_SLICE) \
+            + tag * _MAX_DUP_EDGES + occurrence
+
+    def neighbor_alltoall(self, data: List[Any], tag: int = 0
+                          ) -> List[Any]:
+        """``data[i]`` goes along out-edge ``i`` (to
+        ``out_neighbors[i]``); returns one payload per in-edge, in
+        ``in_neighbors`` order (MPI_Neighbor_alltoall). All edges move
+        concurrently; duplicate edges pair by declaration order on
+        both sides."""
+        if len(data) != len(self._destinations):
+            raise MpiError(
+                f"mpi_tpu: neighbor_alltoall needs "
+                f"{len(self._destinations)} payloads, got {len(data)}")
+        # occurrence index per directed pair, declaration-ordered
+        occ_out: dict = {}
+        sends: List[Request] = []
+        for i, dst in enumerate(self._destinations):
+            k = occ_out.get(dst, 0)
+            occ_out[dst] = k + 1
+            sends.append(Request(
+                lambda d=data[i], t=dst, g=self._edge_tag(tag, k):
+                self.send(d, t, g)))
+        occ_in: dict = {}
+        recvs: List[Request] = []
+        for src in self._sources:
+            k = occ_in.get(src, 0)
+            occ_in[src] = k + 1
+            recvs.append(Request(
+                lambda s=src, g=self._edge_tag(tag, k):
+                self.receive(s, g)))
+        for r in sends:
+            r.wait(timeout=None)
+        return [r.wait(timeout=None) for r in recvs]
+
+    def neighbor_allgather(self, data: Any, tag: int = 0) -> List[Any]:
+        """Send the same ``data`` along every out-edge; collect one
+        payload per in-edge (MPI_Neighbor_allgather)."""
+        return self.neighbor_alltoall(
+            [data] * len(self._destinations), tag=tag)

@@ -1,0 +1,348 @@
+"""Intercommunicators — communication between two disjoint rank groups
+(MPI_Intercomm_create / MPI_Intercomm_merge).
+
+Framework-completeness work with no reference analogue (btracey/mpi has
+a single implicit world, /root/reference/mpi.go:112-119): an
+:class:`Intercomm` connects a *local* group and a *remote* group; every
+point-to-point peer and every collective "other side" is a **remote**
+group rank, exactly MPI's intercommunicator addressing.
+
+Design: an intercommunicator is a thin view over a private **union
+communicator** spanning both groups (a :class:`~mpi_tpu.comm.Comm` with
+its own negotiated context). That buys, for free, everything the
+intracomm layer already has — context isolation from all other traffic,
+driver-compiled group collectives where available, nonblocking
+requests, and ``free()`` — while this module only translates remote
+group ranks to union ranks and applies MPI's intercomm collective
+semantics:
+
+* rooted collectives (``bcast``/``reduce``) use the MPI root protocol:
+  on the root's side the root passes :data:`ROOT` and its peers pass
+  ``None`` (MPI_PROC_NULL); on the receiving side every rank passes the
+  **remote** rank of the root.
+* ``allgather``/``allreduce``/``alltoall`` return data **from the
+  remote group**, per the MPI intercomm definition.
+
+Union ordering is symmetric — the group with the smaller minimum world
+rank comes first — so both sides derive identical union communicators
+without any leader asymmetry.
+
+Construction (:func:`create_intercomm`) is collective over *both*
+groups, wired through a bridge communicator that contains both leaders
+(MPI's ``peer_comm``), and negotiates the union context through the
+same bootstrap band as ``Comm.create_group`` — so the same tag rule
+applies: concurrent constructions whose member sets overlap must use
+distinct ``tag`` values.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, List, Optional, Tuple
+
+from .api import MpiError, Request
+from .comm import Comm, _CTX_MAX, _CREATE_GROUP_TAGS, _propose_ctx, \
+    _raise_ctx_high
+
+if TYPE_CHECKING:
+    from .collectives_generic import OpLike
+
+__all__ = ["Intercomm", "create_intercomm", "ROOT"]
+
+
+class _Root:
+    """Sentinel for MPI_ROOT in rooted intercomm collectives."""
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return "mpi_tpu.intercomm.ROOT"
+
+
+ROOT = _Root()
+
+
+def create_intercomm(local_comm: Comm, local_leader: int,
+                     bridge_comm: Comm, remote_leader: int,
+                     tag: int = 0) -> "Intercomm":
+    """Build an intercommunicator (MPI_Intercomm_create).
+
+    Collective over both groups: every member of each side's
+    ``local_comm`` calls with its own group's ``local_leader`` (a
+    ``local_comm`` rank) and the *other* leader's rank in
+    ``bridge_comm`` (``peer_comm`` in MPI; typically the world). The
+    groups must be disjoint. ``tag`` disambiguates concurrent
+    constructions on the bridge AND selects the union bootstrap slot
+    (shared with ``create_group`` — overlapping concurrent
+    constructions need distinct tags; range ``[0, 4096)``)."""
+    if not 0 <= tag < _CREATE_GROUP_TAGS:
+        raise MpiError(f"mpi_tpu: intercomm tag must be in "
+                       f"[0, {_CREATE_GROUP_TAGS}), got {tag}")
+    local_comm._check_peer(local_leader)
+    me = local_comm.rank()
+    local_world = local_comm.members
+
+    # Leaders swap group membership over the bridge; everyone else
+    # learns it from their leader. The payload rides a bridge user tag,
+    # so a distinct `tag` isolates concurrent constructions.
+    if me == local_leader:
+        remote_world = bridge_comm.sendrecv(
+            tuple(local_world), dest=remote_leader, source=remote_leader,
+            tag=tag)
+    else:
+        remote_world = None
+    remote_world = tuple(local_comm.bcast(remote_world, root=local_leader))
+
+    overlap = set(local_world) & set(remote_world)
+    if overlap:
+        raise MpiError(f"mpi_tpu: intercomm groups overlap on world "
+                       f"ranks {sorted(overlap)}")
+
+    union, _ = _union_comm(local_comm._impl, local_world,
+                           remote_world, tag)
+    return Intercomm(union, local_world, remote_world)
+
+
+def _union_comm(impl, local_world: Tuple[int, ...],
+                remote_world: Tuple[int, ...], tag: int
+                ) -> Tuple[Comm, bool]:
+    """Negotiate a fresh context over the union of both groups and
+    return (union comm, whether the local group is the first block).
+
+    Ordering is the symmetric rule from the module doc; the context
+    negotiation runs over an ephemeral bootstrap comm in the
+    create_group band (comm.py: _CTX_MAX-1-tag), which is safe for the
+    same reason create_group's is — the band sits above any negotiable
+    context, and the user tag keeps concurrent overlapping bootstraps
+    apart."""
+    first_is_local = min(local_world) < min(remote_world)
+    ordered = (tuple(local_world) + tuple(remote_world)) if first_is_local \
+        else (tuple(remote_world) + tuple(local_world))
+    boot = Comm(impl, ordered, _CTX_MAX - 1 - tag, _ephemeral_tags=True)
+    try:
+        bid = _propose_ctx(impl)
+        new_ctx = max(int(b) for b in boot.allgather(bid))
+        _raise_ctx_high(impl, new_ctx)
+    finally:
+        boot.free()
+    return Comm(impl, ordered, new_ctx), first_is_local
+
+
+class Intercomm:
+    """Two disjoint groups joined for mutual communication. Obtain via
+    :func:`create_intercomm`. Peers of every p2p call and the "other
+    side" of every collective are **remote group ranks**."""
+
+    def __init__(self, union: Comm, local_world: Tuple[int, ...],
+                 remote_world: Tuple[int, ...]):
+        self._union = union
+        self._local_world = tuple(local_world)
+        self._remote_world = tuple(remote_world)
+
+    # -- identity -----------------------------------------------------------
+
+    def rank(self) -> int:
+        """This process's rank in the LOCAL group."""
+        w = self._union._impl.rank()
+        try:
+            return self._local_world.index(w)
+        except ValueError:
+            raise MpiError(
+                f"mpi_tpu: world rank {w} is not in this intercomm's "
+                f"local group {self._local_world}") from None
+
+    def size(self) -> int:
+        """Local group size (MPI_Comm_size on an intercomm)."""
+        return len(self._local_world)
+
+    def remote_size(self) -> int:
+        return len(self._remote_world)
+
+    @property
+    def local_members(self) -> Tuple[int, ...]:
+        """World ranks of the local group, by local rank."""
+        return self._local_world
+
+    @property
+    def remote_members(self) -> Tuple[int, ...]:
+        """World ranks of the remote group, by remote rank."""
+        return self._remote_world
+
+    @property
+    def context(self) -> int:
+        return self._union.context
+
+    def __repr__(self) -> str:
+        return (f"Intercomm(ctx={self._union.context}, "
+                f"local={self._local_world}, remote={self._remote_world})")
+
+    # -- rank translation ---------------------------------------------------
+
+    def _remote_to_union(self, remote_rank: int) -> int:
+        if not 0 <= remote_rank < len(self._remote_world):
+            raise MpiError(
+                f"mpi_tpu: remote rank {remote_rank} out of range "
+                f"[0, {len(self._remote_world)})")
+        return self._union.members.index(self._remote_world[remote_rank])
+
+    def _local_to_union(self, local_rank: int) -> int:
+        return self._union.members.index(self._local_world[local_rank])
+
+    # -- point-to-point (peer = remote group rank) --------------------------
+
+    def send(self, data: Any, dest: int, tag: int) -> None:
+        self._union.send(data, self._remote_to_union(dest), tag)
+
+    def receive(self, source: int, tag: int,
+                out: Optional[Any] = None) -> Any:
+        return self._union.receive(self._remote_to_union(source), tag,
+                                   out=out)
+
+    def sendrecv(self, data: Any, dest: int, source: int, tag: int,
+                 out: Optional[Any] = None) -> Any:
+        return self._union.sendrecv(
+            data, dest=self._remote_to_union(dest),
+            source=self._remote_to_union(source), tag=tag, out=out)
+
+    def isend(self, data: Any, dest: int, tag: int) -> Request:
+        return Request(lambda: self.send(data, dest, tag))
+
+    def irecv(self, source: int, tag: int,
+              out: Optional[Any] = None) -> Request:
+        return Request(lambda: self.receive(source, tag, out=out))
+
+    def iprobe(self, source: int, tag: int) -> bool:
+        return self._union.iprobe(self._remote_to_union(source), tag)
+
+    # -- collectives (MPI intercomm semantics) ------------------------------
+    #
+    # All are collective over BOTH groups. The union comm's collective
+    # machinery provides ordering and tag isolation; the intercomm
+    # semantics (data flows between the groups, not within) are applied
+    # on top. Rooted ops use the MPI root protocol (module doc).
+
+    def barrier(self) -> None:
+        self._union.barrier()
+
+    def allgather(self, data: Any) -> List[Any]:
+        """Contribute ``data``; receive the REMOTE group's
+        contributions, indexed by remote rank."""
+        every = self._union.allgather(data)
+        return [every[self._union.members.index(w)]
+                for w in self._remote_world]
+
+    def alltoall(self, data: List[Any]) -> List[Any]:
+        """``data[j]`` goes to remote rank ``j``; returns what each
+        remote rank sent this rank, indexed by remote rank. Both sides
+        must pass ``remote_size()`` payloads."""
+        if len(data) != len(self._remote_world):
+            raise MpiError(
+                f"mpi_tpu: intercomm alltoall needs "
+                f"{len(self._remote_world)} payloads, got {len(data)}")
+        me = self.rank()
+        # Delegate to the union alltoall with payloads placed at the
+        # union ranks of the remote group (None padding toward our own
+        # side, discarded by the receivers' selection).
+        union_payload: List[Any] = [None] * len(self._union.members)
+        for j, w in enumerate(self._remote_world):
+            union_payload[self._union.members.index(w)] = data[j]
+        got = self._union.alltoall(union_payload)
+        return [got[self._union.members.index(w)]
+                for w in self._remote_world]
+
+    def bcast(self, data: Any = None, root: Any = None) -> Optional[Any]:
+        """Rooted broadcast across the groups (MPI root protocol). On
+        the root's side the root passes ``root=ROOT`` (plus the
+        payload) and its peers pass ``root=None`` (MPI_PROC_NULL); on
+        the receiving side every rank passes the **remote** rank of the
+        root. Receivers return the payload; the sending side returns
+        ``None``.
+
+        A small root-discovery allgather precedes the broadcast so
+        sending-side peers genuinely need no knowledge of which of
+        them is root — the full MPI_PROC_NULL contract — and so the
+        named-root/actual-root agreement is verified instead of
+        silently mis-delivering."""
+        mine = self._local_to_union(self.rank()) if root is ROOT else None
+        marks = self._union.allgather(mine)
+        roots = [i for i, m in enumerate(marks) if m is not None]
+        if len(roots) != 1:
+            raise MpiError(
+                f"mpi_tpu: intercomm bcast needs exactly one ROOT "
+                f"caller, saw {len(roots)}")
+        union_root = roots[0]
+        payload = self._union.bcast((True, data) if root is ROOT else None,
+                                    root=union_root)
+        if root is ROOT or root is None:
+            return None
+        if self._remote_to_union(root) != union_root:
+            raise MpiError(
+                "mpi_tpu: intercomm bcast root mismatch — receiver "
+                "named a different root than the ROOT caller")
+        return payload[1]
+
+    def allreduce(self, data: Any, op: "OpLike" = "sum") -> Any:
+        """Contribute ``data``; every rank receives the reduction of
+        the REMOTE group's contributions (the MPI intercomm rule)."""
+        from . import collectives_generic as gen
+
+        gen.check_op(op)
+        every = self._union.allgather(data)
+        remote = [every[self._union.members.index(w)]
+                  for w in self._remote_world]
+        return gen.tree_combine(remote, op)
+
+    def reduce(self, data: Any = None, root: Any = None,
+               op: "OpLike" = "sum") -> Optional[Any]:
+        """Rooted reduction: the REMOTE group's contributions reduce to
+        the root. Root passes ``root=ROOT`` and receives the value;
+        its group peers pass ``root=None``; the contributing side
+        passes the remote rank of the root and provides ``data``."""
+        from . import collectives_generic as gen
+
+        gen.check_op(op)
+        contributing = root is not ROOT and root is not None
+        every = self._union.allgather(
+            (root is ROOT, data if contributing else None))
+        # Same protocol validation as bcast: exactly one ROOT caller,
+        # or the contributed data would be silently discarded.
+        n_roots = sum(1 for (is_root, _) in every if is_root)
+        if n_roots != 1:
+            raise MpiError(
+                f"mpi_tpu: intercomm reduce needs exactly one ROOT "
+                f"caller, saw {n_roots}")
+        if root is not ROOT:
+            return None
+        remote = [every[self._union.members.index(w)][1]
+                  for w in self._remote_world]
+        return gen.tree_combine(remote, op)
+
+    # -- merge --------------------------------------------------------------
+
+    def merge(self, high: bool = False) -> Comm:
+        """Collapse into an intracommunicator (MPI_Intercomm_merge):
+        collective over both groups; the group(s) passing ``high=False``
+        order first. If both sides pass the same flag, the group with
+        the smaller minimum world rank orders first (deterministic on
+        both sides). Group-internal order is preserved."""
+        w = self._union._impl.rank()
+        # Group identity travels as the group's minimum world rank (the
+        # same key both sides can compute), because "local" is relative
+        # to each caller.
+        my_side = min(self._local_world)
+        flags = self._union.allgather((my_side, bool(high)))
+        local_flag = next(f for (s, f) in flags if s == my_side)
+        remote_flag = next(f for (s, f) in flags if s != my_side)
+        if local_flag == remote_flag:
+            local_first = min(self._local_world) < min(self._remote_world)
+        else:
+            local_first = not local_flag  # low group first
+        ordered = (self._local_world + self._remote_world) if local_first \
+            else (self._remote_world + self._local_world)
+        # Fresh context via split on the union, keyed by the merged
+        # position so the child's rank order IS the merged order.
+        key = ordered.index(w)
+        child = self._union.split(color=0, key=key)
+        assert child is not None and child.members == ordered
+        return child
+
+    def free(self) -> None:
+        """Release the private union communicator's driver resources."""
+        self._union.free()

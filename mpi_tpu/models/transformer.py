@@ -452,7 +452,8 @@ def make_optimizer(optimizer: str = "adamw", learning_rate: float = 1e-3,
 def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                      learning_rate: float = 1e-3, grad_accum: int = 1,
                      optimizer: str = "adamw", warmup_steps: int = 0,
-                     total_steps: Optional[int] = None):
+                     total_steps: Optional[int] = None,
+                     zero1: bool = False):
     """Build (init_state, step_body) with ``step_body`` left un-jitted —
     for callers that embed the step in a larger program (the bench
     harness scans it; :func:`make_train_step` jits it as-is). Both
@@ -466,12 +467,22 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     averaged). The batch must divide by ``k``.
 
     ``optimizer``/``warmup_steps``/``total_steps`` select the update
-    rule and schedule — see :func:`make_optimizer`."""
+    rule and schedule — see :func:`make_optimizer`.
+
+    ``zero1=True`` (requires a mesh with a ``dp`` axis) shards the
+    optimizer state over ``dp`` (:mod:`mpi_tpu.parallel.zero`): GSPMD
+    then turns the dp gradient psum into a reduce-scatter, updates
+    each device's 1/dp state shard, and all-gathers the fresh params —
+    AdamW state memory drops ~dp-fold with the same step math up to
+    float reduction order."""
     import optax
 
     if grad_accum < 1:
         raise ValueError(f"mpi_tpu: grad_accum must be >= 1, got "
                          f"{grad_accum}")
+    if zero1 and (mesh is None or "dp" not in mesh.axis_names):
+        raise ValueError(
+            "mpi_tpu: zero1=True needs a mesh with a 'dp' axis")
     if mesh is not None and "tp" in mesh.axis_names:
         tp = mesh.shape["tp"]
         if cfg.n_heads % tp or cfg.kv_heads % tp:
@@ -482,18 +493,26 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     opt = make_optimizer(optimizer, learning_rate, warmup_steps,
                          total_steps)
 
+    def _sane_param_specs(params):
+        specs = param_specs(cfg)
+        return jax.tree.unflatten(
+            jax.tree.structure(params),
+            [sanitize_spec(s, mesh) for s in jax.tree.leaves(
+                specs, is_leaf=lambda s: isinstance(s, P))])
+
     def init_state(key: jax.Array):
         params = init_params(key, cfg)
         if mesh is not None:
-            specs = param_specs(cfg)
+            sane_specs = _sane_param_specs(params)
             params = jax.tree.map(
-                lambda x, s: jax.device_put(
-                    x, NamedSharding(mesh, sanitize_spec(s, mesh))),
-                params, jax.tree.unflatten(
-                    jax.tree.structure(params),
-                    jax.tree.leaves(specs, is_leaf=lambda s: isinstance(
-                        s, P))))
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                params, sane_specs)
             opt_state = jax.jit(opt.init)(params)
+            if zero1:
+                from ..parallel.zero import shard_opt_state, zero1_specs
+
+                zspecs = zero1_specs(params, sane_specs, opt_state, mesh)
+                opt_state = shard_opt_state(opt_state, zspecs, mesh)
         else:
             opt_state = opt.init(params)
         return {"params": params, "opt": opt_state}
@@ -525,6 +544,19 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
         loss, grads = accumulate(state["params"], tokens)
         updates, new_opt = opt.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
+        if zero1:
+            from ..parallel.zero import constrain_opt_state, zero1_specs
+
+            # Specs are derived at trace time from the state itself, so
+            # the constraint holds even for states that bypassed
+            # init_state (checkpoint restores); pinning the updated
+            # state to the dp-sharded layouts keeps GSPMD on the
+            # reduce-scatter/all-gather plan instead of replicating
+            # state between steps.
+            zspecs = zero1_specs(state["params"],
+                                 _sane_param_specs(state["params"]),
+                                 new_opt, mesh)
+            new_opt = constrain_opt_state(new_opt, zspecs, mesh)
         return {"params": new_params, "opt": new_opt}, loss
 
     return init_state, step
@@ -533,7 +565,8 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
 def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                     learning_rate: float = 1e-3, grad_accum: int = 1,
                     optimizer: str = "adamw", warmup_steps: int = 0,
-                    total_steps: Optional[int] = None):
+                    total_steps: Optional[int] = None,
+                    zero1: bool = False):
     """Build (init_state, step). ``step(state, tokens) -> (state, loss)``
     is one fully jitted optimizer step; with a mesh, params/opt-state are
     committed to :func:`param_specs` shardings and the batch to
@@ -545,7 +578,8 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                                         grad_accum=grad_accum,
                                         optimizer=optimizer,
                                         warmup_steps=warmup_steps,
-                                        total_steps=total_steps)
+                                        total_steps=total_steps,
+                                        zero1=zero1)
     # Donate the incoming state: params + optimizer state alias their
     # output buffers, halving peak HBM for the largest tensors in the
     # step (the standard TPU training setup; callers rebind

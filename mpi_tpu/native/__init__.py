@@ -7,6 +7,13 @@ pybind11 needed — the ABI is plain C). Everything degrades gracefully:
 if no compiler is present or ``MPI_TPU_NO_NATIVE=1`` is set, callers get
 ``None`` and use their pure-Python fallbacks, with identical semantics
 (tests cover both paths).
+
+Libraries:
+
+* ``wirecore`` (native/wirecore.cpp) — framed send/receive on blocking
+  sockets for the TCP driver's hot data path (writev, GIL-free).
+* ``shmcore`` (native/shmcore.cpp) — shared-memory SPSC ring transport
+  for the ``shm`` protocol (futex-blocked, spin fast path).
 """
 
 from __future__ import annotations
@@ -18,17 +25,12 @@ import subprocess
 import sys
 import tempfile
 import threading
-from typing import Optional
+from typing import Callable, Dict, Optional
 
-__all__ = ["wirecore", "available", "build_error"]
+__all__ = ["wirecore", "shmcore", "available", "build_error"]
 
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
-_error: Optional[str] = None
-
-_SRC = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native", "wirecore.cpp")
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
 
 PEER_CLOSED = 1000
 
@@ -39,27 +41,7 @@ def _cache_dir() -> str:
     return os.path.join(base, "mpi_tpu")
 
 
-def _build() -> ctypes.CDLL:
-    with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    out_dir = _cache_dir()
-    os.makedirs(out_dir, exist_ok=True)
-    so_path = os.path.join(out_dir, f"wirecore-{digest}.so")
-    if not os.path.exists(so_path):
-        fd, tmp = tempfile.mkstemp(suffix=".so", dir=out_dir)
-        os.close(fd)
-        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-               _SRC, "-o", tmp]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(tmp, so_path)  # atomic publish; races converge
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
-    lib = ctypes.CDLL(so_path)
+def _configure_wirecore(lib: ctypes.CDLL) -> None:
     lib.wc_send_frame.restype = ctypes.c_int
     lib.wc_send_frame.argtypes = [
         ctypes.c_int, ctypes.c_uint8, ctypes.c_int64,
@@ -71,44 +53,137 @@ def _build() -> ctypes.CDLL:
     lib.wc_version.restype = ctypes.c_int
     if lib.wc_version() != 2:
         raise RuntimeError("wirecore version mismatch")
-    return lib
+
+
+def _configure_shmcore(lib: ctypes.CDLL) -> None:
+    lib.shm_ring_create.restype = ctypes.c_int
+    lib.shm_ring_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.POINTER(ctypes.c_void_p)]
+    lib.shm_ring_attach.restype = ctypes.c_int
+    lib.shm_ring_attach.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.shm_ring_unlink.restype = ctypes.c_int
+    lib.shm_ring_unlink.argtypes = [ctypes.c_char_p]
+    lib.shm_ring_mark_closed.restype = None
+    lib.shm_ring_mark_closed.argtypes = [ctypes.c_void_p]
+    lib.shm_ring_close.restype = None
+    lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+    lib.shm_send_frame.restype = ctypes.c_int
+    lib.shm_send_frame.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint8, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int]
+    lib.shm_recv_hdr.restype = ctypes.c_int
+    lib.shm_recv_hdr.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_int]
+    lib.shm_recv_payload.restype = ctypes.c_int
+    lib.shm_recv_payload.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int]
+    lib.shm_version.restype = ctypes.c_int
+    if lib.shm_version() != 1:
+        raise RuntimeError("shmcore version mismatch")
+
+
+class _Lib:
+    """Lazy build+load state for one native library."""
+
+    def __init__(self, stem: str,
+                 configure: Callable[[ctypes.CDLL], None]):
+        self.stem = stem
+        self.src = os.path.join(_NATIVE_DIR, f"{stem}.cpp")
+        self.configure = configure
+        self.lock = threading.Lock()
+        self.lib: Optional[ctypes.CDLL] = None
+        self.tried = False
+        self.error: Optional[str] = None
+
+    def _build(self) -> ctypes.CDLL:
+        with open(self.src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        out_dir = _cache_dir()
+        os.makedirs(out_dir, exist_ok=True)
+        so_path = os.path.join(out_dir, f"{self.stem}-{digest}.so")
+        if not os.path.exists(so_path):
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=out_dir)
+            os.close(fd)
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                   self.src, "-o", tmp, "-pthread"]
+            try:
+                try:
+                    subprocess.run(cmd, check=True, capture_output=True,
+                                   timeout=120)
+                except subprocess.CalledProcessError as exc:
+                    # Older glibc keeps shm_open in librt; retry with
+                    # -lrt ONLY for that link failure — a blanket retry
+                    # would mask real compile errors and double their
+                    # cost.
+                    stderr = (exc.stderr or b"").decode("utf-8", "replace")
+                    if "shm_open" not in stderr and "shm_unlink" \
+                            not in stderr:
+                        raise
+                    subprocess.run(cmd + ["-lrt"], check=True,
+                                   capture_output=True, timeout=120)
+                os.replace(tmp, so_path)  # atomic publish; races converge
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        lib = ctypes.CDLL(so_path)
+        self.configure(lib)
+        return lib
+
+    def load(self) -> Optional[ctypes.CDLL]:
+        if self.tried:
+            return self.lib
+        with self.lock:
+            if self.tried:
+                return self.lib
+            if os.environ.get("MPI_TPU_NO_NATIVE") \
+                    or not sys.platform.startswith("linux") \
+                    or sys.byteorder != "little":
+                # The wire format is explicit little-endian; the engines
+                # memcpy host-order ints, so big-endian hosts must not load.
+                self.error = "disabled"
+            else:
+                try:
+                    self.lib = self._build()
+                except BaseException as exc:  # noqa: BLE001 - fall back
+                    self.error = f"{type(exc).__name__}: {exc}"
+            self.tried = True
+            return self.lib
+
+
+_LIBS: Dict[str, _Lib] = {
+    "wirecore": _Lib("wirecore", _configure_wirecore),
+    "shmcore": _Lib("shmcore", _configure_shmcore),
+}
 
 
 def wirecore() -> Optional[ctypes.CDLL]:
-    """The loaded native library, building it on first use; None if
+    """The loaded socket frame engine, building on first use; None if
     unavailable (non-linux, no compiler, or MPI_TPU_NO_NATIVE=1)."""
-    global _lib, _tried, _error
-    if _tried:
-        return _lib
-    with _lock:
-        if _tried:
-            return _lib
-        if os.environ.get("MPI_TPU_NO_NATIVE") \
-                or not sys.platform.startswith("linux") \
-                or sys.byteorder != "little":
-            # The wire format is explicit little-endian; wirecore.cpp
-            # memcpys host-order ints, so big-endian hosts must not load.
-            _error = "disabled"
-        else:
-            try:
-                _lib = _build()
-            except BaseException as exc:  # noqa: BLE001 - fall back to python
-                _error = f"{type(exc).__name__}: {exc}"
-        _tried = True
-        return _lib
+    return _LIBS["wirecore"].load()
 
 
-def available() -> bool:
-    return wirecore() is not None
+def shmcore() -> Optional[ctypes.CDLL]:
+    """The loaded shared-memory ring engine; None if unavailable."""
+    return _LIBS["shmcore"].load()
 
 
-def build_error() -> Optional[str]:
+def available(stem: str = "wirecore") -> bool:
+    return _LIBS[stem].load() is not None
+
+
+def build_error(stem: str = "wirecore") -> Optional[str]:
     """Why the native core is unavailable (None if loaded or untried)."""
-    wirecore()
-    return _error
+    _LIBS[stem].load()
+    return _LIBS[stem].error
 
 
 def _reset_for_testing() -> None:
-    global _lib, _tried, _error
-    with _lock:
-        _lib, _tried, _error = None, False, None
+    for entry in _LIBS.values():
+        with entry.lock:
+            entry.lib, entry.tried, entry.error = None, False, None

@@ -29,11 +29,15 @@ from .ring_attention import (
 from .halo import halo_exchange, jacobi_step_1d, jacobi_step_2d
 from .pipeline import pipeline, pipeline_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
+from .zero import constrain_opt_state, shard_opt_state, zero1_specs
 
 __all__ = [
     "make_mesh",
     "mesh_devices",
     "rank_axis",
+    "zero1_specs",
+    "shard_opt_state",
+    "constrain_opt_state",
     "ring_attention",
     "ring_flash_attention",
     "ring_flash_attention_zigzag",

@@ -1,0 +1,397 @@
+// shmcore — shared-memory ring transport for intra-host ranks.
+//
+// The reference's transport speaks TCP even between ranks on one machine
+// (network.go:122-159 dials every peer over the network stack). This is
+// the rebuild's native fast path for that case: each connection between
+// two ranks on the same host is a pair of single-producer single-consumer
+// byte rings in POSIX shared memory (/dev/shm), one ring per direction,
+// carrying exactly the same frame stream as the TCP driver's sockets
+// (kind:u8 tag:i64le length:u32le payload — backends/tcp.py). The Python
+// driver selects this engine via `-mpi-protocol shm`.
+//
+// Ring layout (one shared-memory object per ring):
+//     [0, 4096)   RingHdr (magic, capacity, head/tail counters, futex
+//                 words, ready/closed flags; 64-byte-aligned fields so
+//                 producer and consumer counters sit on separate lines)
+//     [4096, 4096+capacity)   data area, byte ring addressed mod capacity
+//
+// head counts bytes ever produced, tail bytes ever consumed; both only
+// grow (u64 — no wrap at realistic lifetimes). Producer publishes with a
+// release store of head after the memcpy; consumer reads with an acquire
+// load, and vice versa for tail. Each side bumps its futex word after
+// progress and wakes the peer; waits are BOUNDED (2 ms) so a missed wake
+// — possible when the peer is the pure-Python fallback ring, which never
+// issues futex calls — costs only latency, never a hang. The hot path
+// spins briefly before sleeping, so same-host ping-pong latency stays in
+// the sub-microsecond range.
+//
+// Signal cooperation mirrors wirecore.cpp: a futex wait interrupted by a
+// signal returns -EINTR to the caller with the operation's progress saved
+// inside the handle; re-invoking with identical arguments resumes, and
+// between calls CPython runs pending signal handlers (Ctrl+C).
+//
+// All functions return 0 on success or -errno on failure; kPeerClosed
+// (1000) means the peer marked the ring closed and no buffered bytes
+// remain. Little-endian hosts only (enforced by the Python loader).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x524d4853;  // "SHMR" little-endian
+constexpr int kPeerClosed = 1000;
+constexpr uint64_t kHdrBytes = 4096;
+constexpr uint64_t kFrameHdrLen = 13;
+constexpr int kBoundedWaitMs = 2;  // cap per futex sleep; see module doc
+
+// Spin budget before sleeping. Spinning only helps when the peer can
+// make progress on ANOTHER core; on a single-core host it actively
+// starves the peer (the spinner burns the timeslice the peer needs to
+// produce the data), so there the budget is zero and waits go straight
+// to futex — which yields the core immediately.
+int spin_iters() {
+  static const int iters = [] {
+    long n = ::sysconf(_SC_NPROCESSORS_ONLN);
+    return n > 1 ? 500 : 0;
+  }();
+  return iters;
+}
+
+struct alignas(64) RingHdr {
+  uint32_t magic;
+  uint32_t capacity;
+  std::atomic<uint32_t> ready;
+  std::atomic<uint32_t> closed;
+  alignas(64) std::atomic<uint64_t> head;  // bytes produced
+  std::atomic<uint32_t> wseq;              // futex word: producer progress
+  alignas(64) std::atomic<uint64_t> tail;  // bytes consumed
+  std::atomic<uint32_t> rseq;              // futex word: consumer progress
+};
+
+static_assert(sizeof(RingHdr) <= kHdrBytes, "header must fit its page");
+
+struct Handle {
+  RingHdr *hdr;
+  uint8_t *data;
+  uint64_t map_len;
+  int fd;
+  // Resumable per-frame progress (one in-flight op per handle: a ring is
+  // used in exactly one direction by exactly one thread at a time).
+  uint64_t op_done;
+  uint8_t frame_hdr[kFrameHdrLen];
+};
+
+inline void cpu_relax() {
+#if defined(__x86_64__) || defined(__i386__)
+  __builtin_ia32_pause();
+#endif
+}
+
+int futex_wait_bounded(std::atomic<uint32_t> *word, uint32_t expect,
+                       int remaining_ms) {
+  int ms = remaining_ms < 0 ? kBoundedWaitMs
+                            : (remaining_ms < kBoundedWaitMs ? remaining_ms
+                                                             : kBoundedWaitMs);
+  if (ms <= 0) ms = 1;
+  timespec ts{ms / 1000, (ms % 1000) * 1000000L};
+  long rc = ::syscall(SYS_futex, reinterpret_cast<uint32_t *>(word),
+                      FUTEX_WAIT, expect, &ts, nullptr, 0);
+  if (rc == -1 && errno == EINTR) return -EINTR;
+  return 0;  // woken, timed out, or value changed — caller re-checks
+}
+
+void futex_wake_all(std::atomic<uint32_t> *word) {
+  ::syscall(SYS_futex, reinterpret_cast<uint32_t *>(word), FUTEX_WAKE,
+            INT32_MAX, nullptr, nullptr, 0);
+}
+
+// Monotonic milliseconds now.
+int64_t now_ms() {
+  timespec ts;
+  ::clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+// Copy n bytes into the ring at absolute position pos (mod capacity).
+void ring_store(Handle *h, uint64_t pos, const uint8_t *src, uint64_t n) {
+  uint64_t cap = h->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = n < cap - off ? n : cap - off;
+  std::memcpy(h->data + off, src, first);
+  if (n > first) std::memcpy(h->data, src + first, n - first);
+}
+
+void ring_load(Handle *h, uint64_t pos, uint8_t *dst, uint64_t n) {
+  uint64_t cap = h->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = n < cap - off ? n : cap - off;
+  std::memcpy(dst, h->data + off, first);
+  if (n > first) std::memcpy(dst + first, h->data, n - first);
+}
+
+// Producer: append n bytes, blocking for space. Progress in *done.
+int ring_write(Handle *h, const uint8_t *src, uint64_t n, int timeout_ms,
+               uint64_t *done) {
+  RingHdr *r = h->hdr;
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  while (*done < n) {
+    if (r->closed.load(std::memory_order_acquire)) return kPeerClosed;
+    uint64_t head = r->head.load(std::memory_order_relaxed);
+    uint64_t tail = r->tail.load(std::memory_order_acquire);
+    uint64_t space = r->capacity - (head - tail);
+    if (space == 0) {
+      bool progressed = false;
+      for (int i = spin_iters(); i > 0; --i) {
+        cpu_relax();
+        if (r->tail.load(std::memory_order_acquire) != tail ||
+            r->closed.load(std::memory_order_acquire)) {
+          progressed = true;
+          break;
+        }
+      }
+      if (!progressed) {
+        uint32_t seq = r->rseq.load(std::memory_order_acquire);
+        if (r->tail.load(std::memory_order_acquire) == tail &&
+            !r->closed.load(std::memory_order_acquire)) {
+          int remaining = -1;
+          if (deadline >= 0) {
+            remaining = int(deadline - now_ms());
+            if (remaining <= 0) return -ETIMEDOUT;
+          }
+          int rc = futex_wait_bounded(&r->rseq, seq, remaining);
+          if (rc == -EINTR) return -EINTR;
+        }
+      }
+      continue;
+    }
+    uint64_t chunk = n - *done < space ? n - *done : space;
+    ring_store(h, head, src + *done, chunk);
+    r->head.store(head + chunk, std::memory_order_release);
+    r->wseq.fetch_add(1, std::memory_order_release);
+    futex_wake_all(&r->wseq);
+    *done += chunk;
+  }
+  return 0;
+}
+
+// Consumer: read exactly n bytes, blocking for data. Progress in *done.
+int ring_read(Handle *h, uint8_t *dst, uint64_t n, int timeout_ms,
+              uint64_t *done) {
+  RingHdr *r = h->hdr;
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  while (*done < n) {
+    uint64_t tail = r->tail.load(std::memory_order_relaxed);
+    uint64_t head = r->head.load(std::memory_order_acquire);
+    uint64_t avail = head - tail;
+    if (avail == 0) {
+      // closed checked only when drained: buffered bytes stay readable
+      // after the peer closes, like a half-closed TCP stream.
+      if (r->closed.load(std::memory_order_acquire) &&
+          r->head.load(std::memory_order_acquire) == tail)
+        return kPeerClosed;
+      bool progressed = false;
+      for (int i = spin_iters(); i > 0; --i) {
+        cpu_relax();
+        if (r->head.load(std::memory_order_acquire) != head ||
+            r->closed.load(std::memory_order_acquire)) {
+          progressed = true;
+          break;
+        }
+      }
+      if (!progressed) {
+        uint32_t seq = r->wseq.load(std::memory_order_acquire);
+        if (r->head.load(std::memory_order_acquire) == head &&
+            !r->closed.load(std::memory_order_acquire)) {
+          int remaining = -1;
+          if (deadline >= 0) {
+            remaining = int(deadline - now_ms());
+            if (remaining <= 0) return -ETIMEDOUT;
+          }
+          int rc = futex_wait_bounded(&r->wseq, seq, remaining);
+          if (rc == -EINTR) return -EINTR;
+        }
+      }
+      continue;
+    }
+    uint64_t chunk = n - *done < avail ? n - *done : avail;
+    ring_load(h, tail, dst + *done, chunk);
+    r->tail.store(tail + chunk, std::memory_order_release);
+    r->rseq.fetch_add(1, std::memory_order_release);
+    futex_wake_all(&r->rseq);
+    *done += chunk;
+  }
+  return 0;
+}
+
+Handle *map_handle(int fd, uint64_t map_len) {
+  void *mem =
+      ::mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) return nullptr;
+  Handle *h = new Handle();
+  h->hdr = static_cast<RingHdr *>(mem);
+  h->data = static_cast<uint8_t *>(mem) + kHdrBytes;
+  h->map_len = map_len;
+  h->fd = fd;
+  h->op_done = 0;
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a ring of `capacity` data bytes under shm name `name`
+// (must start with '/'). Fails with -EEXIST if the name is live.
+// Returns a handle via *out.
+int shm_ring_create(const char *name, uint32_t capacity, void **out) {
+  *out = nullptr;
+  if (capacity == 0) return -EINVAL;
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  uint64_t map_len = kHdrBytes + capacity;
+  if (::ftruncate(fd, static_cast<off_t>(map_len)) != 0) {
+    int e = errno;
+    ::close(fd);
+    ::shm_unlink(name);
+    return -e;
+  }
+  Handle *h = map_handle(fd, map_len);
+  if (h == nullptr) {
+    int e = errno;
+    ::close(fd);
+    ::shm_unlink(name);
+    return -e;
+  }
+  RingHdr *r = h->hdr;
+  r->capacity = capacity;
+  r->head.store(0, std::memory_order_relaxed);
+  r->tail.store(0, std::memory_order_relaxed);
+  r->wseq.store(0, std::memory_order_relaxed);
+  r->rseq.store(0, std::memory_order_relaxed);
+  r->closed.store(0, std::memory_order_relaxed);
+  r->magic = kMagic;
+  r->ready.store(1, std::memory_order_release);
+  *out = h;
+  return 0;
+}
+
+// Attach to an existing ring. -ENOENT / -EAGAIN mean "not there yet /
+// not initialized yet" — the caller retries until its init timeout
+// (the dial-retry loop, network.go:297-312).
+int shm_ring_attach(const char *name, void **out) {
+  *out = nullptr;
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || static_cast<uint64_t>(st.st_size) < kHdrBytes) {
+    ::close(fd);
+    return -EAGAIN;
+  }
+  Handle *probe = map_handle(fd, kHdrBytes);
+  if (probe == nullptr) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  bool ready = probe->hdr->ready.load(std::memory_order_acquire) == 1 &&
+               probe->hdr->magic == kMagic;
+  uint32_t capacity = probe->hdr->capacity;
+  ::munmap(probe->hdr, probe->map_len);
+  delete probe;
+  if (!ready || static_cast<uint64_t>(st.st_size) < kHdrBytes + capacity) {
+    ::close(fd);
+    return -EAGAIN;
+  }
+  Handle *h = map_handle(fd, kHdrBytes + capacity);
+  if (h == nullptr) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  *out = h;
+  return 0;
+}
+
+int shm_ring_unlink(const char *name) {
+  return ::shm_unlink(name) == 0 ? 0 : -errno;
+}
+
+// Mark closed and wake both sides; safe from either end, idempotent.
+void shm_ring_mark_closed(void *handle) {
+  Handle *h = static_cast<Handle *>(handle);
+  h->hdr->closed.store(1, std::memory_order_release);
+  h->hdr->wseq.fetch_add(1, std::memory_order_release);
+  h->hdr->rseq.fetch_add(1, std::memory_order_release);
+  futex_wake_all(&h->hdr->wseq);
+  futex_wake_all(&h->hdr->rseq);
+}
+
+void shm_ring_close(void *handle) {
+  Handle *h = static_cast<Handle *>(handle);
+  ::munmap(h->hdr, h->map_len);
+  ::close(h->fd);
+  delete h;
+}
+
+// Send one frame (header + payload). Resumes after -EINTR when called
+// again with identical arguments; progress lives in the handle.
+int shm_send_frame(void *handle, uint8_t kind, int64_t tag,
+                   const uint8_t *payload, uint32_t length, int timeout_ms) {
+  Handle *h = static_cast<Handle *>(handle);
+  if (h->op_done == 0) {
+    h->frame_hdr[0] = kind;
+    std::memcpy(h->frame_hdr + 1, &tag, 8);
+    std::memcpy(h->frame_hdr + 9, &length, 4);
+  }
+  if (h->op_done < kFrameHdrLen) {
+    int rc = ring_write(h, h->frame_hdr, kFrameHdrLen, timeout_ms,
+                        &h->op_done);
+    if (rc != 0) return rc;
+  }
+  uint64_t payload_done = h->op_done - kFrameHdrLen;
+  int rc = ring_write(h, payload, length, timeout_ms, &payload_done);
+  h->op_done = kFrameHdrLen + payload_done;
+  if (rc != 0) return rc;
+  h->op_done = 0;
+  return 0;
+}
+
+// Phase 1 of a receive: the 13-byte frame header. Resumable after
+// -EINTR. On success the parsed fields are returned and the handle is
+// ready for shm_recv_payload (which must consume exactly *length).
+int shm_recv_hdr(void *handle, uint8_t *kind, int64_t *tag, uint32_t *length,
+                 int timeout_ms) {
+  Handle *h = static_cast<Handle *>(handle);
+  int rc = ring_read(h, h->frame_hdr, kFrameHdrLen, timeout_ms, &h->op_done);
+  if (rc != 0) return rc;
+  h->op_done = 0;
+  *kind = h->frame_hdr[0];
+  std::memcpy(tag, h->frame_hdr + 1, 8);
+  std::memcpy(length, h->frame_hdr + 9, 4);
+  return 0;
+}
+
+// Phase 2: the payload bytes announced by the last shm_recv_hdr.
+int shm_recv_payload(void *handle, uint8_t *buf, uint32_t length,
+                     int timeout_ms) {
+  Handle *h = static_cast<Handle *>(handle);
+  int rc = ring_read(h, buf, length, timeout_ms, &h->op_done);
+  if (rc != 0) return rc;
+  h->op_done = 0;
+  return 0;
+}
+
+int shm_version() { return 1; }
+
+}  // extern "C"

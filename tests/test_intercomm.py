@@ -1,0 +1,399 @@
+"""Intercommunicator and distributed-graph topology tests.
+
+MPI semantics under test: intercomm peers/collectives address the
+REMOTE group (MPI_Intercomm_create/merge), dist-graph neighborhood
+collectives move data along declared edges only
+(MPI_Dist_graph_create_adjacent). No reference analogue (btracey/mpi
+has one implicit world); run over the xla driver's SPMD harness and
+spot-checked over TCP.
+"""
+
+import numpy as np
+import pytest
+
+import mpi_tpu
+from mpi_tpu import api
+from mpi_tpu.api import MpiError
+from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+from mpi_tpu.comm import comm_world
+from mpi_tpu.distgraph import dist_graph_create_adjacent
+from mpi_tpu.intercomm import ROOT, create_intercomm
+
+from conftest import run_on_ranks, tcp_cluster
+
+N = 6  # world: ranks 0-2 = group A, 3-5 = group B
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    api._reset_for_testing()
+    yield
+    api._reset_for_testing()
+
+
+def _make_intercomm(tag=0):
+    """Standard fixture world: split into A (even colors) and B, bridge
+    over the world. Returns (inter, world, side) for the calling rank."""
+    w = comm_world()
+    side = 0 if w.rank() < 3 else 1
+    local = w.split(color=side, key=w.rank())
+    inter = create_intercomm(local, 0, w, 0 if side else 3, tag=tag)
+    return inter, w, side, local
+
+
+class TestCreate:
+    def test_identity_and_sizes(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            out = (side, inter.rank(), inter.size(), inter.remote_size(),
+                   inter.local_members, inter.remote_members)
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=N)
+        for wr, (side, r, sz, rsz, lm, rm) in enumerate(res):
+            assert sz == 3 and rsz == 3
+            if side == 0:
+                assert lm == (0, 1, 2) and rm == (3, 4, 5) and r == wr
+            else:
+                assert lm == (3, 4, 5) and rm == (0, 1, 2) and r == wr - 3
+
+    def test_overlapping_groups_rejected(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            try:
+                # "local" and "remote" are both the whole world.
+                create_intercomm(w, 0, w, 0, tag=1)
+                err = None
+            except MpiError as exc:
+                err = str(exc)
+            mpi_tpu.finalize()
+            return err
+
+        res = run_spmd(main, n=2)
+        assert all(e is not None and "overlap" in e for e in res)
+
+
+class TestP2P:
+    def test_send_receive_addresses_remote_ranks(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            me = inter.rank()
+            # pairwise exchange: local rank i <-> remote rank i
+            got = inter.sendrecv(f"{side}:{me}", dest=me, source=me, tag=4)
+            mpi_tpu.finalize()
+            return got
+
+        res = run_spmd(main, n=N)
+        # world rank 0 (A, local 0) paired with remote rank 0 = world 3
+        assert res[0] == "1:0" and res[3] == "0:0"
+        assert res[2] == "1:2" and res[5] == "0:2"
+
+    def test_intercomm_tags_isolated_from_world(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            me = inter.rank()
+            # Same tag on world and intercomm simultaneously: must not mix.
+            wr = w.rank()
+            if wr == 0:
+                w.send(b"world", 1, 9)
+                inter.send(b"inter", 0, 9)
+                out = None
+            elif wr == 1:
+                out = (w.receive(0, 9), None)
+            elif wr == 3:
+                out = (None, inter.receive(0, 9))
+            else:
+                out = None
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=N)
+        assert res[1][0] == b"world"
+        assert res[3][1] == b"inter"
+
+
+class TestCollectives:
+    def test_allgather_returns_remote_group(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            got = inter.allgather((side, inter.rank()))
+            mpi_tpu.finalize()
+            return got
+
+        res = run_spmd(main, n=N)
+        for wr, got in enumerate(res):
+            other = 1 if wr < 3 else 0
+            assert got == [(other, 0), (other, 1), (other, 2)]
+
+    def test_allreduce_reduces_remote_values(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            # A ranks contribute 1, B ranks contribute 10
+            mine = 1 if side == 0 else 10
+            got = inter.allreduce(np.int64(mine), op="sum")
+            mpi_tpu.finalize()
+            return int(got)
+
+        res = run_spmd(main, n=N)
+        assert res[:3] == [30, 30, 30]  # A sees sum of B
+        assert res[3:] == [3, 3, 3]     # B sees sum of A
+
+    def test_bcast_root_protocol(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            if side == 0:
+                # A is the sending side; A rank 1 is root.
+                root = ROOT if inter.rank() == 1 else None
+                got = inter.bcast(b"payload" if root is ROOT else None,
+                                  root=root)
+            else:
+                got = inter.bcast(root=1)  # remote rank of the root
+            mpi_tpu.finalize()
+            return got
+
+        res = run_spmd(main, n=N)
+        assert res[:3] == [None, None, None]
+        assert res[3:] == [b"payload"] * 3
+
+    def test_reduce_to_root(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            if side == 1:
+                got = inter.reduce(
+                    np.float64(inter.rank() + 1.0), root=0, op="max")
+            else:
+                # op must match on every rank of both groups (MPI rule)
+                got = inter.reduce(
+                    root=ROOT if inter.rank() == 0 else None, op="max")
+            mpi_tpu.finalize()
+            return got if got is None else float(got)
+
+        res = run_spmd(main, n=N)
+        assert res[0] == 3.0           # max of B's 1,2,3 lands on A root
+        assert all(r is None for r in res[1:])
+
+    def test_alltoall_crosses_groups(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            me = inter.rank()
+            got = inter.alltoall(
+                [f"{side}{me}->{j}" for j in range(inter.remote_size())])
+            mpi_tpu.finalize()
+            return got
+
+        res = run_spmd(main, n=N)
+        # world 4 = B rank 1 receives from A ranks 0..2, slot = sender
+        assert res[4] == ["00->1", "01->1", "02->1"]
+        assert res[1] == ["10->1", "11->1", "12->1"]
+
+
+class TestMerge:
+    def test_merge_low_high_ordering(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            # B declares itself low, A high -> merged order: B then A
+            merged = inter.merge(high=(side == 0))
+            out = (merged.members, merged.rank())
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=N)
+        assert all(m == (3, 4, 5, 0, 1, 2) for m, _ in res)
+        assert [r for _, r in res] == [3, 4, 5, 0, 1, 2]
+
+    def test_merge_tie_breaks_by_min_world_rank(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            merged = inter.merge(high=False)  # both low -> A first
+            out = merged.members
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=N)
+        assert all(m == (0, 1, 2, 3, 4, 5) for m in res)
+
+    def test_merged_comm_collectives_work(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            merged = inter.merge()
+            got = merged.allreduce(np.int64(1), op="sum")
+            mpi_tpu.finalize()
+            return int(got)
+
+        res = run_spmd(main, n=N)
+        assert res == [N] * N
+
+
+class TestDistGraph:
+    def test_ring_graph_neighbor_allgather(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            n, me = w.size(), w.rank()
+            # directed ring: receive from left, send to right
+            g = dist_graph_create_adjacent(
+                w, sources=[(me - 1) % n], destinations=[(me + 1) % n])
+            got = g.neighbor_allgather(f"tok{me}")
+            out = (g.in_neighbors, g.out_neighbors, got)
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=4)
+        for me, (ins, outs, got) in enumerate(res):
+            assert ins == ((me - 1) % 4,)
+            assert outs == ((me + 1) % 4,)
+            assert got == [f"tok{(me - 1) % 4}"]
+
+    def test_irregular_graph_alltoall(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            me = w.rank()
+            # star: rank 0 sends to everyone else; they reply to 0
+            if me == 0:
+                g = dist_graph_create_adjacent(
+                    w, sources=[1, 2, 3], destinations=[1, 2, 3])
+                got = g.neighbor_alltoall(["a1", "a2", "a3"])
+            else:
+                g = dist_graph_create_adjacent(
+                    w, sources=[0], destinations=[0])
+                got = g.neighbor_alltoall([f"r{me}"])
+            mpi_tpu.finalize()
+            return got
+
+        res = run_spmd(main, n=4)
+        assert res[0] == ["r1", "r2", "r3"]
+        assert res[1] == ["a1"] and res[3] == ["a3"]
+
+    def test_duplicate_edges_pair_in_order(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            me = w.rank()
+            # two parallel edges 0 -> 1 (multigraph)
+            if me == 0:
+                g = dist_graph_create_adjacent(
+                    w, sources=[], destinations=[1, 1])
+                got = g.neighbor_alltoall(["first", "second"])
+            else:
+                g = dist_graph_create_adjacent(
+                    w, sources=[0, 0], destinations=[])
+                got = g.neighbor_alltoall([])
+            mpi_tpu.finalize()
+            return got
+
+        res = run_spmd(main, n=2)
+        assert res[0] == []
+        assert res[1] == ["first", "second"]
+
+    def test_inconsistent_graph_raises_everywhere(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            me = w.rank()
+            try:
+                # 0 claims an edge to 1; 1 declares no sources.
+                dist_graph_create_adjacent(
+                    w, sources=[], destinations=[1] if me == 0 else [])
+                err = None
+            except MpiError as exc:
+                err = str(exc)
+            mpi_tpu.finalize()
+            return err
+
+        res = run_spmd(main, n=2)
+        assert all(e is not None and "inconsistent" in e for e in res)
+
+    def test_self_edges_allowed(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            me = w.rank()
+            g = dist_graph_create_adjacent(
+                w, sources=[me], destinations=[me])
+            got = g.neighbor_allgather(f"self{me}")
+            mpi_tpu.finalize()
+            return got
+
+        res = run_spmd(main, n=2)
+        assert res == [["self0"], ["self1"]]
+
+
+class TestOverTcp:
+    def test_intercomm_over_tcp_cluster(self):
+        with tcp_cluster(4) as nets:
+            def body(net, r):
+                w = comm_world(net)
+                side = r % 2
+                local = w.split(color=side, key=r)
+                inter = create_intercomm(local, 0, w, 1 - side, tag=2)
+                got = inter.allgather(r)
+                merged = inter.merge()
+                total = merged.allreduce(np.int64(r), op="sum")
+                return got, int(total)
+
+            res = run_on_ranks(nets, body)
+            # evens (0,2) see odds' world ranks and vice versa
+            assert res[0][0] == [1, 3] and res[1][0] == [0, 2]
+            assert all(t == 6 for _, t in res)
+
+
+class TestWtime:
+    def test_wtime_monotonic_and_wtick(self):
+        t0 = mpi_tpu.wtime()
+        t1 = mpi_tpu.wtime()
+        assert t1 >= t0
+        assert 0 < mpi_tpu.wtick() < 1.0
+
+
+class TestFailLoud:
+    def test_bad_adjacency_raises_on_every_rank_no_deadlock(self):
+        # Local argument errors must not diverge before the collective
+        # split: the erring rank joins the error exchange so compliant
+        # ranks raise too instead of hanging (distgraph fail-loud
+        # contract).
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            try:
+                dist_graph_create_adjacent(
+                    w, sources=[],
+                    destinations=[99] if w.rank() == 0 else [])
+                err = None
+            except MpiError as exc:
+                err = str(exc)
+            mpi_tpu.finalize()
+            return err
+
+        res = run_spmd(main, n=3)
+        assert all(e is not None and "out of range" in e for e in res)
+
+    def test_reduce_without_root_caller_raises(self):
+        def main():
+            mpi_tpu.init()
+            inter, w, side, _ = _make_intercomm()
+            try:
+                # contributing side names a root, but nobody passes ROOT
+                inter.reduce(np.int64(1) if side == 1 else None,
+                             root=0 if side == 1 else None)
+                err = None
+            except MpiError as exc:
+                err = str(exc)
+            mpi_tpu.finalize()
+            return err
+
+        res = run_spmd(main, n=N)
+        assert all(e is not None and "exactly one ROOT" in e for e in res)

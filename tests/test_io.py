@@ -1,0 +1,266 @@
+"""Parallel file IO tests (mpi_tpu/io.py — the MPI-IO analogue).
+
+Semantics under test: collective open/close, positioned independent
+and collective reads/writes (MPI_File_read_at[_all]), strided views
+(MPI_File_set_view + MPI_Type_vector), and rank-ordered variable-size
+writes (MPI_File_write_ordered). Runs over the xla SPMD harness and a
+TCP process pair; no reference analogue (btracey/mpi has no file IO).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import mpi_tpu
+from mpi_tpu import api
+from mpi_tpu.api import MpiError
+from mpi_tpu.backends.xla import run_spmd
+from mpi_tpu.comm import comm_world
+from mpi_tpu.io import open_file
+
+from conftest import run_on_ranks, tcp_cluster
+
+N = 4
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    api._reset_for_testing()
+    yield
+    api._reset_for_testing()
+
+
+class TestBasics:
+    def test_collective_open_write_read_close(self, tmp_path):
+        path = tmp_path / "data.bin"
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            with open_file(w, path, "w") as f:
+                # rank r owns bytes [100r, 100r+100)
+                f.write_at_all(100 * r, np.full(100, r, np.uint8))
+                got = f.read_at_all(0, 100 * w.size())
+            mpi_tpu.finalize()
+            return got
+
+        res = run_spmd(main, n=N)
+        expect = np.repeat(np.arange(N, dtype=np.uint8), 100)
+        for got in res:
+            np.testing.assert_array_equal(got, expect)
+
+    def test_read_only_mode_rejects_writes(self, tmp_path):
+        path = tmp_path / "ro.bin"
+        path.write_bytes(b"\x00" * 8)
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            f = open_file(w, path, "r")
+            try:
+                f.write_at(0, b"x")
+                err = None
+            except MpiError as exc:
+                err = str(exc)
+            f.close()
+            mpi_tpu.finalize()
+            return err
+
+        res = run_spmd(main, n=2)
+        assert all(e and "read-only" in e for e in res)
+
+    def test_missing_file_raises_everywhere(self, tmp_path):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            try:
+                open_file(w, tmp_path / "nope.bin", "r")
+                err = None
+            except MpiError as exc:
+                err = str(exc)
+            mpi_tpu.finalize()
+            return err
+
+        res = run_spmd(main, n=2)
+        assert all(e is not None for e in res)
+
+    def test_size_and_set_size(self, tmp_path):
+        path = tmp_path / "sz.bin"
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            with open_file(w, path, "w") as f:
+                f.set_size(4096)
+                s = f.size()
+            mpi_tpu.finalize()
+            return s
+
+        assert run_spmd(main, n=2) == [4096, 4096]
+
+    def test_short_read_raises(self, tmp_path):
+        path = tmp_path / "short.bin"
+        path.write_bytes(b"abc")
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            f = open_file(w, path, "r")
+            try:
+                f.read_at(0, 100)
+                err = None
+            except MpiError as exc:
+                err = str(exc)
+            f.close()
+            mpi_tpu.finalize()
+            return err
+
+        res = run_spmd(main, n=2)
+        assert all(e and "short read" in e for e in res)
+
+
+class TestTypedData:
+    def test_float32_roundtrip_bitwise(self, tmp_path):
+        path = tmp_path / "f32.bin"
+        base = np.random.default_rng(0).standard_normal(256).astype(
+            np.float32)
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            shard = base[r * 64:(r + 1) * 64]
+            with open_file(w, path, "w") as f:
+                f.write_at_all(r * 64 * 4, shard)
+                got = f.read_at_all(0, 256, np.float32)
+            mpi_tpu.finalize()
+            return got
+
+        for got in run_spmd(main, n=N):
+            np.testing.assert_array_equal(got, base)  # bitwise
+
+
+class TestViews:
+    def test_row_cyclic_view_roundtrip(self, tmp_path):
+        path = tmp_path / "view.bin"
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            with open_file(w, path, "w") as f:
+                # canonical row-cyclic split: block=8 int32 per round
+                f.set_view(disp=0, dtype=np.int32, block=8)
+                f.write_all(np.arange(32, dtype=np.int32) + 1000 * r)
+                back = f.read_all(32)
+                flat = f.read_at_all(0, 32 * w.size(), np.int32)
+            mpi_tpu.finalize()
+            return back, flat
+
+        res = run_spmd(main, n=N)
+        for r, (back, flat) in enumerate(res):
+            np.testing.assert_array_equal(
+                back, np.arange(32, dtype=np.int32) + 1000 * r)
+        # interleaving on disk: round k holds rank0 block, rank1 block, ...
+        flat = res[0][1].reshape(4, 4, 8)  # rounds x ranks x block
+        for r in range(4):
+            np.testing.assert_array_equal(
+                flat[:, r, :].reshape(-1),
+                np.arange(32, dtype=np.int32) + 1000 * r)
+
+    def test_partial_tail_block(self, tmp_path):
+        path = tmp_path / "tail.bin"
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            with open_file(w, path, "w") as f:
+                f.set_view(dtype=np.int16, block=5)
+                f.write_all(np.arange(13, dtype=np.int16) + 100 * r)
+                back = f.read_all(13)
+            mpi_tpu.finalize()
+            return back
+
+        for r, back in enumerate(run_spmd(main, n=2)):
+            np.testing.assert_array_equal(
+                back, np.arange(13, dtype=np.int16) + 100 * r)
+
+    def test_bad_view_rejected(self, tmp_path):
+        path = tmp_path / "bad.bin"
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            with open_file(w, path, "w") as f:
+                try:
+                    f.set_view(block=4, stride=2)
+                    err = None
+                except MpiError as exc:
+                    err = str(exc)
+            mpi_tpu.finalize()
+            return err
+
+        res = run_spmd(main, n=2)
+        assert all(e and "stride" in e for e in res)
+
+
+class TestOrdered:
+    def test_write_ordered_variable_sizes(self, tmp_path):
+        path = tmp_path / "ordered.bin"
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            with open_file(w, path, "w") as f:
+                start = f.write_ordered(bytes([65 + r]) * (r + 1))
+            mpi_tpu.finalize()
+            return start
+
+        starts = run_spmd(main, n=N)
+        # sizes 1,2,3,4 -> starts 0,1,3,6
+        assert starts == [0, 1, 3, 6]
+        assert (tmp_path / "ordered.bin").read_bytes() == \
+            b"A" + b"BB" + b"CCC" + b"DDDD"
+
+
+class TestOverTcp:
+    def test_two_process_style_cluster(self, tmp_path):
+        path = tmp_path / "tcp.bin"
+        with tcp_cluster(2) as nets:
+            def body(net, r):
+                w = comm_world(net)
+                with open_file(w, path, "w") as f:
+                    f.write_at_all(4 * r, np.int32(r + 7))
+                    got = f.read_at_all(0, 2, np.int32)
+                return got
+
+            res = run_on_ranks(nets, body)
+            for got in res:
+                np.testing.assert_array_equal(
+                    got, np.asarray([7, 8], np.int32))
+
+
+class TestDefaultView:
+    def test_default_view_is_whole_file_for_every_rank(self, tmp_path):
+        # MPI's native default view: each rank sees the whole file from
+        # byte 0 — NOT rank-shifted (overlap would corrupt silently).
+        path = tmp_path / "dv.bin"
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            with open_file(w, path, "w") as f:
+                if w.rank() == 0:
+                    f.write_all(np.arange(16, dtype=np.uint8))
+                else:
+                    f.write_all(np.zeros(0, np.uint8))
+                got = f.read_all(16)
+            mpi_tpu.finalize()
+            return got
+
+        for got in run_spmd(main, n=2):
+            np.testing.assert_array_equal(got, np.arange(16, dtype=np.uint8))
