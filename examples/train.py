@@ -40,6 +40,13 @@ def main() -> None:
                          "(train longer sequences in the same HBM)")
     ap.add_argument("--grad-accum", type=int, default=1,
                     help="microbatches per optimizer step")
+    ap.add_argument("--corpus", default=None,
+                    help="raw binary uint16 token file to train on "
+                         "(memory-mapped; native gather kernel); token "
+                         "ids must be < 256, this example's vocab. "
+                         "default: synthetic stream")
+    ap.add_argument("--zero1", action="store_true",
+                    help="shard optimizer state over dp (ZeRO-1)")
     ap.add_argument("--optimizer", default="adamw",
                     choices=["adamw", "adafactor", "sgd"])
     ap.add_argument("--warmup-steps", type=int, default=0,
@@ -89,7 +96,8 @@ def main() -> None:
     init_state, step = make_train_step(
         cfg, mesh=mesh, learning_rate=1e-2, grad_accum=args.grad_accum,
         optimizer=args.optimizer, warmup_steps=args.warmup_steps,
-        total_steps=start + args.steps if args.warmup_steps else None)
+        total_steps=start + args.steps if args.warmup_steps else None,
+        zero1=args.zero1)
     state = init_state(jax.random.PRNGKey(0))
     if start:
         state = restore_checkpoint(args.checkpoint_dir, state)
@@ -97,9 +105,23 @@ def main() -> None:
 
     # Deterministic, resumable, dp-sharded stream with host-side prefetch
     # (restart at --resume replays exactly the batches it would have seen).
-    loader = iter(ShardedLoader(
-        SyntheticLM(cfg.vocab, args.batch, args.seq), mesh=mesh,
-        start_step=start))
+    if args.corpus:
+        import numpy as np
+
+        from mpi_tpu.data import from_token_file
+
+        # Loud one-time validation: out-of-vocab ids would otherwise be
+        # CLAMPED by XLA's gather and train silently on garbage.
+        mx = int(np.memmap(args.corpus, dtype=np.uint16, mode="r").max())
+        if mx >= cfg.vocab:
+            raise SystemExit(
+                f"--corpus contains token id {mx} >= vocab {cfg.vocab}; "
+                f"re-tokenize or remap the corpus first")
+        source = from_token_file(args.corpus, args.batch, args.seq,
+                                 dtype="uint16")
+    else:
+        source = SyntheticLM(cfg.vocab, args.batch, args.seq)
+    loader = iter(ShardedLoader(source, mesh=mesh, start_step=start))
     ckpt = AsyncCheckpointer()
     for i in range(start, start + args.steps):
         tokens = next(loader)

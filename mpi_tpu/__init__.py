@@ -16,8 +16,18 @@ point-to-point library over TCP, /root/reference) designed TPU-first:
     ``gather``/``scatter``/``alltoall``/``scan``/``exscan``/``barrier``
     (the reference stubs
     ``AllReduce`` out, mpi.go:130);
+  * communicators (:mod:`mpi_tpu.comm`: split/dup/create_group, Cartesian
+    topologies), distributed-graph topologies (:mod:`mpi_tpu.distgraph`),
+    intercommunicators (:mod:`mpi_tpu.intercomm`), one-sided RMA windows
+    (:mod:`mpi_tpu.window`), and parallel file IO (:mod:`mpi_tpu.io`);
   * a functional layer (:mod:`mpi_tpu.parallel`) for use *inside* ``jit``
-    ted SPMD code, plus Pallas ring/DMA kernels (:mod:`mpi_tpu.ops`).
+    ted SPMD code — including ZeRO-1 optimizer-state sharding
+    (:mod:`mpi_tpu.parallel.zero`) — plus Pallas ring/DMA kernels
+    (:mod:`mpi_tpu.ops`);
+  * a native runtime core (:mod:`mpi_tpu.native`): C++ socket frame
+    engine, shared-memory ring transport (``-mpi-protocol shm``), and
+    batch-gather data-loader kernel, all ctypes-loaded with pure-Python
+    fallbacks.
 """
 
 from .comm import CartComm, Comm, cart_create, comm_world

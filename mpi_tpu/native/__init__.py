@@ -14,6 +14,8 @@ Libraries:
   sockets for the TCP driver's hot data path (writev, GIL-free).
 * ``shmcore`` (native/shmcore.cpp) — shared-memory SPSC ring transport
   for the ``shm`` protocol (futex-blocked, spin fast path).
+* ``dataloader`` (native/dataloader.cpp) — GIL-free gather+widen of
+  training batches out of a memory-mapped token corpus.
 """
 
 from __future__ import annotations
@@ -27,7 +29,7 @@ import tempfile
 import threading
 from typing import Callable, Dict, Optional
 
-__all__ = ["wirecore", "shmcore", "available", "build_error"]
+__all__ = ["wirecore", "shmcore", "dataloader", "available", "build_error"]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
@@ -53,6 +55,17 @@ def _configure_wirecore(lib: ctypes.CDLL) -> None:
     lib.wc_version.restype = ctypes.c_int
     if lib.wc_version() != 2:
         raise RuntimeError("wirecore version mismatch")
+
+
+def _configure_dataloader(lib: ctypes.CDLL) -> None:
+    lib.dl_gather.restype = ctypes.c_int
+    lib.dl_gather.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_void_p, ctypes.c_int]
+    lib.dl_version.restype = ctypes.c_int
+    if lib.dl_version() != 1:
+        raise RuntimeError("dataloader version mismatch")
 
 
 def _configure_shmcore(lib: ctypes.CDLL) -> None:
@@ -159,6 +172,7 @@ class _Lib:
 _LIBS: Dict[str, _Lib] = {
     "wirecore": _Lib("wirecore", _configure_wirecore),
     "shmcore": _Lib("shmcore", _configure_shmcore),
+    "dataloader": _Lib("dataloader", _configure_dataloader),
 }
 
 
@@ -171,6 +185,11 @@ def wirecore() -> Optional[ctypes.CDLL]:
 def shmcore() -> Optional[ctypes.CDLL]:
     """The loaded shared-memory ring engine; None if unavailable."""
     return _LIBS["shmcore"].load()
+
+
+def dataloader() -> Optional[ctypes.CDLL]:
+    """The loaded batch-gather kernel; None if unavailable."""
+    return _LIBS["dataloader"].load()
 
 
 def available(stem: str = "wirecore") -> bool:
