@@ -224,26 +224,33 @@ def measure_long_context(seq: int = 8192, d_model: int = 1024,
 
 def measure_decode(d_model: int = 1024, n_layers: int = 8, n_heads: int = 8,
                    d_ff: int = 4096, vocab: int = 8192, batch: int = 8,
-                   prompt_len: int = 128, short: int = 16, long: int = 128
-                   ) -> dict:
+                   prompt_len: int = 128, short: int = 16, long: int = 128,
+                   int8: bool = False) -> dict:
     """Inference throughput: greedy KV-cache decode of the flagship model
     (models/generate.py — prefill then one ``lax.scan`` over decode
     steps, all compiled). Per-token time differences a ``long``- and
     ``short``-token generate program so fixed dispatch/tunnel latency
     cancels, same method as the train-step timing. Reports decoded
     tokens/s across the batch — the serving-side twin of the training
-    headline (no reference analogue; btracey/mpi has no models)."""
+    headline (no reference analogue; btracey/mpi has no models).
+
+    ``int8=True`` serves weight-only int8 quantized params
+    (models/quant.py): decode is HBM-bound, so the smaller weight reads
+    are a direct tokens/s lever; keys gain an ``_int8`` suffix."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from mpi_tpu.models import TransformerConfig, generate, init_params
+    from mpi_tpu.models import (TransformerConfig, generate, init_params,
+                                quantize_params)
 
     cfg = TransformerConfig(
         vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
         d_ff=d_ff, max_seq=prompt_len + long, dtype=jnp.bfloat16,
         attention_impl="dense")  # decode attends via the cache, not flash
     params = init_params(jax.random.PRNGKey(0), cfg)
+    if int8:
+        params = jax.jit(quantize_params)(params)
     prompt = jnp.asarray(
         np.random.default_rng(0).integers(0, vocab, (batch, prompt_len)),
         dtype=jnp.int32)
@@ -260,12 +267,13 @@ def measure_decode(d_model: int = 1024, n_layers: int = 8, n_heads: int = 8,
     if per_tok <= 0:
         per_tok = t_long / long
         timing_method = "fallback_total_over_n"
+    sfx = "_int8" if int8 else ""
     return {
-        "decode_ms_per_token": round(per_tok * 1e3, 3),
-        "decode_tokens_per_s": round(batch / per_tok),
-        "decode_batch": batch,
-        "decode_prompt_len": prompt_len,
-        "decode_timing_method": timing_method,
+        f"decode{sfx}_ms_per_token": round(per_tok * 1e3, 3),
+        f"decode{sfx}_tokens_per_s": round(batch / per_tok),
+        f"decode{sfx}_batch": batch,
+        f"decode{sfx}_prompt_len": prompt_len,
+        f"decode{sfx}_timing_method": timing_method,
     }
 
 
@@ -673,12 +681,18 @@ def main() -> int:
         result.update(measure_decode(
             d_model=64, n_layers=2, n_heads=4, d_ff=128, vocab=128,
             batch=2, prompt_len=16, short=4, long=12))
+        _PARTIALS.update(result)
+        result.update(measure_decode(
+            d_model=64, n_layers=2, n_heads=4, d_ff=128, vocab=128,
+            batch=2, prompt_len=16, short=4, long=12, int8=True))
     else:
         result = measure_train_step()
         _PARTIALS.update(result)
         result.update(measure_long_context())
         _PARTIALS.update(result)
         result.update(measure_decode())
+        _PARTIALS.update(result)
+        result.update(measure_decode(int8=True))
     _PARTIALS.update(result)
     ar = measure_allreduce(ar_size)
     _PARTIALS.update(ar)

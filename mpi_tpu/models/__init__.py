@@ -24,6 +24,7 @@ from .transformer import (
 )
 from .moe import init_moe_params, moe_ffn, moe_specs
 from .generate import decode_step, generate, prefill
+from .quant import QTensor, dequantize, quantize, quantize_params
 from .pipeline_lm import (
     forward_pipelined,
     init_pipelined_params,
@@ -33,6 +34,10 @@ from .pipeline_lm import (
 
 __all__ = [
     "TransformerConfig",
+    "QTensor",
+    "quantize",
+    "quantize_params",
+    "dequantize",
     "init_params",
     "forward",
     "forward_with_aux",

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .quant import embed_lookup, logits_matmul
 from .transformer import TransformerConfig, _ffn, _layernorm, apply_rope
 
 __all__ = ["prefill", "decode_step", "generate"]
@@ -72,7 +73,7 @@ def _forward_cached(params, tokens, cache, n_valid, cfg: TransformerConfig):
     """Run ``tokens`` (b, s) starting at absolute position ``n_valid``,
     writing their k/v into the cache. Returns (logits, new_cache)."""
     b, s = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
     if not cfg.rope:
         pos_emb = lax.dynamic_slice_in_dim(
             params["pos"].astype(cfg.dtype), n_valid, s, axis=0)
@@ -95,7 +96,7 @@ def _forward_cached(params, tokens, cache, n_valid, cfg: TransformerConfig):
         x = x + y
     x = _layernorm(x, params["final_ln"]["scale"].astype(x.dtype),
                    params["final_ln"]["bias"].astype(x.dtype))
-    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype))
+    logits = logits_matmul(x, params["embed"])
     return logits, new_cache
 
 

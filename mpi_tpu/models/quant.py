@@ -1,0 +1,126 @@
+"""Weight-only int8 quantization for serving the flagship model.
+
+Decode is HBM-bandwidth-bound: every generated token re-reads every
+weight matrix, so halving (vs bf16) or quartering (vs f32) the bytes
+per matrix is a direct tokens/s lever on TPU — the standard weight-only
+serving recipe. This module quantizes matmul weights to int8 with a
+**per-output-channel absmax scale** (symmetric, last-axis channels);
+activations stay in the compute dtype, and the dequantize
+(``q.astype(dtype) * scale``) fuses into the consuming matmul under
+XLA, so the HBM read is int8 while the MXU contraction stays bf16 —
+bandwidth win without an activation-quantization accuracy cliff.
+
+Usage::
+
+    qparams = quantize_params(params)            # QTensor leaves
+    toks = generate(qparams, prompt, cfg, n)     # same entry points
+
+Every weight consumer in the model calls ``.astype(compute_dtype)`` on
+its weight leaf; :class:`QTensor` implements ``astype`` as dequantize,
+so the float and quantized paths share one forward with no call-site
+changes (plus two gather/logits fast paths below that keep the
+embedding int8 through the memory-heavy ops). Quantized training is
+deliberately unsupported (QTensor carries no gradient story); quantize
+at serving time. No reference analogue (btracey/mpi has no models).
+
+What gets quantized: floating-point leaves with ndim >= 2 — the qkv/o
+projections, FFN and MoE expert weights, and the embedding (which also
+serves as the logits matrix; its dequantize folds into the gather /
+the pre-logits activation). Layernorm scales/biases (1-D) and the
+positional table (additive, precision-sensitive, tiny) stay in their
+original dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["QTensor", "quantize_params", "quantize", "dequantize",
+           "embed_lookup", "logits_matmul"]
+
+
+class QTensor(NamedTuple):
+    """int8 values + per-last-axis-channel float32 scale. Registered as
+    a pytree via NamedTuple, so it flows through jit/scan/device_put."""
+
+    q: jax.Array       # int8, original shape
+    scale: jax.Array   # float32, shape (..., 1 broadcast) = per channel
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def deq(self, dtype) -> jax.Array:
+        """Dequantize to ``dtype``; fuses into the consumer under XLA."""
+        return (self.q.astype(dtype) * self.scale.astype(dtype))
+
+    def astype(self, dtype) -> jax.Array:
+        # Weight consumers call .astype(compute_dtype); behaving like
+        # the dequantized array keeps call sites uniform.
+        return self.deq(dtype)
+
+
+def quantize(w: jax.Array) -> QTensor:
+    """Symmetric per-channel (last axis) absmax int8 quantization."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=tuple(
+        range(w.ndim - 1)), keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale.astype(jnp.float32))
+
+
+def dequantize(t: QTensor, dtype=jnp.float32) -> jax.Array:
+    return t.deq(dtype)
+
+
+def embed_lookup(emb: Any, tokens: jax.Array, dtype) -> jax.Array:
+    """Token-embedding gather that stays int8 until after the gather:
+    indexing the int8 table then scaling the (b, s, d) result reads
+    only the needed rows from HBM, instead of dequantizing the whole
+    (vocab, d) table per step. Plain arrays pass through."""
+    if isinstance(emb, QTensor):
+        return emb.q[tokens].astype(dtype) * \
+            emb.scale.reshape(-1).astype(dtype)
+    return emb.astype(dtype)[tokens]
+
+
+def logits_matmul(x: jax.Array, emb: Any) -> jax.Array:
+    """Tied-embedding logits projection ``x @ emb.T`` with the
+    per-channel scale folded into the activations — the (vocab, d)
+    operand streams from HBM as int8. Plain arrays pass through."""
+    if isinstance(emb, QTensor):
+        scaled = x * emb.scale.reshape(-1).astype(x.dtype)
+        return jnp.einsum("bsd,vd->bsv", scaled, emb.q.astype(x.dtype))
+    return jnp.einsum("bsd,vd->bsv", x, emb.astype(x.dtype))
+
+
+def _should_quantize(path: str, leaf: Any) -> bool:
+    arr = jnp.asarray(leaf)
+    if arr.ndim < 2 or not jnp.issubdtype(arr.dtype, jnp.floating):
+        return False
+    # Additive positional table: tiny, precision-sensitive — skip.
+    return "pos" != path.split("/")[-1]
+
+
+def quantize_params(params: Any) -> Any:
+    """Return ``params`` with every matmul weight replaced by a
+    :class:`QTensor` (see module doc for the selection rule)."""
+    def walk(node: Any, path: str) -> Any:
+        if isinstance(node, dict):
+            return {k: walk(v, f"{path}/{k}") for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            out = [walk(v, f"{path}/{i}") for i, v in enumerate(node)]
+            return type(node)(out) if isinstance(node, tuple) else out
+        if _should_quantize(path, node):
+            return quantize(jnp.asarray(node))
+        return node
+
+    return walk(params, "")
