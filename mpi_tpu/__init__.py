@@ -83,6 +83,13 @@ from .api import (
     size,
     wtime,
     wtick,
+    set_errhandler,
+    get_errhandler,
+    allreduce_init,
+    bcast_init,
+    barrier_init,
+    pack,
+    unpack,
 )
 
 __version__ = "0.1.0"
@@ -142,6 +149,13 @@ __all__ = [
     "size",
     "wtime",
     "wtick",
+    "set_errhandler",
+    "get_errhandler",
+    "allreduce_init",
+    "bcast_init",
+    "barrier_init",
+    "pack",
+    "unpack",
     "Intercomm",
     "create_intercomm",
     "DistGraphComm",

@@ -34,6 +34,7 @@ back to generic tree/ring algorithms built on ``send``/``receive``
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import (TYPE_CHECKING, Any, Callable, List, Optional, Protocol,
@@ -89,6 +90,15 @@ __all__ = [
     "MpiError",
     "TagError",
     "NotInitializedError",
+    "set_errhandler",
+    "get_errhandler",
+    "allreduce_init",
+    "bcast_init",
+    "barrier_init",
+    "pack",
+    "unpack",
+    "wtime",
+    "wtick",
 ]
 
 
@@ -246,6 +256,78 @@ def size() -> int:
     return _require_init().size()
 
 
+# --------------------------------------------------------------------------
+# Error handlers (MPI_Errhandler analogue)
+# --------------------------------------------------------------------------
+#
+# The reference documents both styles — "errors may be returned or the
+# implementation may panic" (mpi.go:20-21) — which is exactly MPI's
+# MPI_ERRORS_RETURN vs MPI_ERRORS_ARE_FATAL choice. The facade defaults
+# to returning (raising MpiError); "fatal" aborts the process like
+# MPI_ERRORS_ARE_FATAL (and like the reference's panics); a callable is
+# an observer hook (logging/cleanup) invoked before the error re-raises.
+# The handler fires wherever a facade op EXECUTES — including the
+# worker threads of nonblocking/persistent ops, whose bodies are the
+# guarded blocking calls. "fatal" therefore aborts the process even
+# for an isend misuse (matching MPI_ERRORS_ARE_FATAL's abort-the-job
+# semantics); callable handlers must be thread-safe. With "return"
+# (default), a worker-thread error is stored and re-raised at wait().
+
+_errhandler: Any = "return"
+
+
+def set_errhandler(handler: Any) -> Any:
+    """Install the world error handler; returns the previous one.
+
+    ``"return"`` (default) raises :class:`MpiError` to the caller;
+    ``"fatal"`` prints the error and terminates the process with exit
+    code 13 (MPI_ERRORS_ARE_FATAL — matching the reference's panic
+    stance, mpi.go:20-21); a callable ``handler(exc)`` is called first,
+    then the error raises normally (unless the handler itself raises
+    something else)."""
+    global _errhandler
+    if handler not in ("return", "fatal") and not callable(handler):
+        raise MpiError(
+            f"mpi_tpu: errhandler must be 'return', 'fatal', or a "
+            f"callable, got {handler!r}")
+    previous, _errhandler = _errhandler, handler
+    return previous
+
+
+def get_errhandler() -> Any:
+    return _errhandler
+
+
+def _dispatch_error(exc: MpiError) -> None:
+    """Route ``exc`` through the installed handler; never returns
+    normally (raises or exits)."""
+    handler = _errhandler
+    if handler == "fatal":
+        import sys as _sys
+        import traceback as _tb
+
+        _tb.print_exception(type(exc), exc, exc.__traceback__,
+                            file=_sys.stderr)
+        print("mpi_tpu: aborting (errhandler=fatal)", file=_sys.stderr)
+        os._exit(13)
+    if callable(handler):
+        handler(exc)
+    raise exc
+
+
+def _guarded(fn: Callable) -> Callable:
+    """Wrap a facade op so MpiErrors route through the errhandler."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any):
+        try:
+            return fn(*args, **kwargs)
+        except MpiError as exc:
+            _dispatch_error(exc)
+    return wrapped
+
+
 def wtime() -> float:
     """Elapsed wall-clock seconds from an arbitrary fixed origin
     (MPI_Wtime; no reference analogue — bounce times with Go's
@@ -269,6 +351,7 @@ def _payload_bytes(data: Any) -> int:
     return int(nbytes) if isinstance(nbytes, int) else 0
 
 
+@_guarded
 def send(data: Any, dest: int, tag: int) -> None:
     """Blocking rendezvous send (mpi.go:126-128): returns only once rank
     ``dest`` has accepted the message (network.go:569,617-624)."""
@@ -286,6 +369,7 @@ def send(data: Any, dest: int, tag: int) -> None:
         impl.send(data, dest, tag)
 
 
+@_guarded
 def receive(source: int, tag: int, out: Optional[Any] = None) -> Any:
     """Blocking receive (mpi.go:157-159). Returns the decoded payload.
 
@@ -330,6 +414,7 @@ def _iprobe_fn(impl: Interface) -> Callable[[int, int], bool]:
     return probe_fn
 
 
+@_guarded
 def iprobe(source: int, tag: int) -> bool:
     """Non-consuming message probe (MPI_Iprobe): True when a message
     from ``source`` with ``tag`` is available — a matching ``receive``
@@ -343,6 +428,7 @@ def iprobe(source: int, tag: int) -> bool:
     return bool(_iprobe_fn(impl)(source, tag))
 
 
+@_guarded
 def probe(source: int, tag: int, timeout: Optional[float] = None) -> None:
     """Blocking probe (MPI_Probe): return once a message from ``source``
     with ``tag`` is available (without consuming it); ``MpiError`` on
@@ -392,6 +478,7 @@ def exchange(impl: Interface, data: Any, dest: int, source: int, tag: int,
     return result[0]
 
 
+@_guarded
 def sendrecv(data: Any, dest: int, source: int, tag: int,
              out: Optional[Any] = None) -> Any:
     """Concurrent send+receive, the idiom every reference example spells
@@ -440,6 +527,7 @@ def _check_tag(tag: int) -> None:
 # Native backend methods win; otherwise generic algorithms over send/receive.
 # ---------------------------------------------------------------------------
 
+@_guarded
 def _collective(name: str, *args: Any, **kwargs: Any) -> Any:
     impl = _require_init()
     # A blocking collective must not race this thread's outstanding
@@ -626,8 +714,16 @@ class PersistentRequest:
     MPI amortizes envelope setup; here it amortizes the closure and
     keeps the call sites declarative."""
 
-    def __init__(self, fn: Callable[[], Any]):
+    def __init__(self, fn: Callable[[], Any],
+                 launcher: Optional[Callable[[Callable[[], Any]],
+                                             "Request"]] = None):
         self._fn = fn
+        # How start() turns fn into a Request. Persistent COLLECTIVES
+        # pass a launcher that chains onto the caller thread's
+        # i-collective chain (see _persistent_collective) so their
+        # instances keep the collective ordering contract; p2p ops use
+        # a plain Request.
+        self._launch = launcher if launcher is not None else Request
         self._active: Optional[Request] = None
 
     def start(self) -> "PersistentRequest":
@@ -644,7 +740,7 @@ class PersistentRequest:
                 "mpi_tpu: PersistentRequest.start() before wait() on the "
                 "completed previous instance (its result/error would be "
                 "lost)")
-        self._active = Request(self._fn)
+        self._active = self._launch(self._fn)
         return self
 
     def test(self) -> bool:
@@ -688,10 +784,8 @@ def send_init(data_or_supplier: Any, dest: int, tag: int) -> PersistentRequest:
     evaluated at each :meth:`~PersistentRequest.start` — the analogue of
     MPI's buffer re-read, for payloads that change between iterations."""
     _require_init()
-    if callable(data_or_supplier):
-        return PersistentRequest(
-            lambda: send(data_or_supplier(), dest, tag))
-    return PersistentRequest(lambda: send(data_or_supplier, dest, tag))
+    supplier = _as_supplier(data_or_supplier)
+    return PersistentRequest(lambda: send(supplier(), dest, tag))
 
 
 def recv_init(source: int, tag: int,
@@ -700,6 +794,109 @@ def recv_init(source: int, tag: int,
     returns that instance's payload."""
     _require_init()
     return PersistentRequest(lambda: receive(source, tag, out))
+
+
+def _as_supplier(data_or_supplier: Any) -> Callable[[], Any]:
+    """The callable-vs-payload coercion every ``*_init`` shares: a
+    zero-arg callable is re-read at each start (MPI's buffer re-read);
+    anything else is the fixed payload."""
+    if callable(data_or_supplier):
+        return data_or_supplier
+    return lambda: data_or_supplier
+
+
+def _persistent_collective(name: str, supplier: Callable[[], Tuple],
+                           ) -> PersistentRequest:
+    impl = _require_init()
+    # start() must join the caller thread's i-collective chain — a
+    # plain Request would run _collective in a fresh worker thread
+    # whose empty TLS makes its _drain_chain a no-op, letting the
+    # instance race outstanding nonblocking collectives (or another
+    # in-flight persistent instance) into the positional rendezvous.
+    return PersistentRequest(
+        lambda: _collective(name, *supplier()),
+        launcher=lambda fn: _chained_request((id(impl), 0), fn))
+
+
+def allreduce_init(data_or_supplier: Any,
+                   op: "OpLike" = "sum") -> PersistentRequest:
+    """Persistent allreduce (MPI-4 MPI_Allreduce_init). Each
+    :meth:`~PersistentRequest.start` runs one allreduce round; as with
+    every collective, all ranks must start their instances in the same
+    collective order. ``data_or_supplier`` may be a zero-arg callable
+    re-read at each start (the MPI buffer-re-read analogue)."""
+    supplier = _as_supplier(data_or_supplier)
+    return _persistent_collective("allreduce", lambda: (supplier(), op))
+
+
+def bcast_init(data_or_supplier: Any = None,
+               root: int = 0) -> PersistentRequest:
+    """Persistent broadcast (MPI_Bcast_init); each completed ``wait()``
+    returns that round's payload."""
+    supplier = _as_supplier(data_or_supplier)
+    return _persistent_collective("bcast", lambda: (supplier(), root))
+
+
+def barrier_init() -> PersistentRequest:
+    """Persistent barrier (MPI_Barrier_init)."""
+    return _persistent_collective("barrier", lambda: ())
+
+
+# --------------------------------------------------------------------------
+# Pack / Unpack (MPI_Pack / MPI_Unpack analogue)
+# --------------------------------------------------------------------------
+
+def pack(*items: Any) -> bytes:
+    """Serialize ``items`` into one contiguous buffer (MPI_Pack).
+
+    Each item is encoded with the wire codec (the same typed encoding
+    ``send`` uses — ndarrays round-trip dtype/shape losslessly) behind
+    a u64 length prefix, so a packed buffer is self-describing and can
+    ride any transport or file as a single payload. The reference's
+    gob encoding plays this role implicitly; here it is explicit."""
+    import struct as _struct
+
+    from .utils.serialize import encode as _encode
+
+    parts: List[bytes] = []
+    for item in items:
+        payload = _encode(item)
+        parts.append(_struct.pack("<Q", len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def unpack(buf: Any) -> Tuple[Any, ...]:
+    """Inverse of :func:`pack`: decode every packed item, in order."""
+    import struct as _struct
+
+    from .utils.serialize import decode as _decode
+
+    # Normalize to a byte-granular view: a caller-supplied memoryview
+    # with itemsize > 1 (e.g. over a uint64 array) would make len()
+    # count elements while unpack_from offsets count bytes.
+    if isinstance(buf, memoryview):
+        view = buf.cast("B") if buf.contiguous else memoryview(bytes(buf))
+    elif isinstance(buf, (bytes, bytearray)):
+        view = memoryview(buf)
+    else:
+        view = memoryview(bytes(buf))
+    out: List[Any] = []
+    pos = 0
+    total = len(view)
+    while pos < total:
+        if pos + 8 > total:
+            raise MpiError(
+                f"mpi_tpu: truncated pack buffer at offset {pos}")
+        (n,) = _struct.unpack_from("<Q", view, pos)
+        pos += 8
+        if pos + n > total:
+            raise MpiError(
+                f"mpi_tpu: pack item of {n} bytes overruns buffer "
+                f"({total - pos} left)")
+        out.append(_decode(bytearray(view[pos:pos + n])))
+        pos += n
+    return tuple(out)
 
 
 def waitany(requests: List[Optional[Request]],

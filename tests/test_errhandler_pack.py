@@ -1,0 +1,197 @@
+"""Error handlers, pack/unpack, persistent collectives.
+
+MPI semantics under test: MPI_ERRORS_RETURN vs MPI_ERRORS_ARE_FATAL vs
+a user handler (the reference documents exactly this choice — "errors
+may be returned or the implementation may panic", mpi.go:20-21);
+MPI_Pack/MPI_Unpack round-trips through the wire codec; and MPI-4
+persistent collectives (MPI_Allreduce_init family).
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import mpi_tpu
+from mpi_tpu import api
+from mpi_tpu.api import MpiError
+from mpi_tpu.backends.xla import XlaNetwork, run_spmd
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    api._reset_for_testing()
+    api.set_errhandler("return")
+    yield
+    api.set_errhandler("return")
+    api._reset_for_testing()
+
+
+class TestErrhandler:
+    def test_default_is_return_and_raises(self):
+        assert api.get_errhandler() == "return"
+
+        def main():
+            mpi_tpu.init()
+            try:
+                mpi_tpu.send(b"x", 99, 0)  # out-of-range peer
+                out = None
+            except MpiError as exc:
+                out = str(exc)
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert all(o and "out of range" in o for o in res)
+
+    def test_callable_handler_observes_then_raises(self):
+        seen = []
+
+        def main():
+            mpi_tpu.init()
+            api.set_errhandler(lambda exc: seen.append(str(exc)))
+            try:
+                try:
+                    mpi_tpu.receive(50, 1)
+                    ok = False
+                except MpiError:
+                    ok = True
+            finally:
+                api.set_errhandler("return")
+            mpi_tpu.finalize()
+            return ok
+
+        res = run_spmd(main, n=2)
+        assert all(res) and len(seen) == 2
+
+    def test_set_errhandler_returns_previous_and_validates(self):
+        prev = api.set_errhandler("fatal")
+        assert prev == "return"
+        assert api.set_errhandler("return") == "fatal"
+        with pytest.raises(MpiError, match="errhandler"):
+            api.set_errhandler("explode")
+
+    @pytest.mark.integration
+    def test_fatal_aborts_process_with_13(self, tmp_path):
+        # fatal must *terminate* (MPI_ERRORS_ARE_FATAL / the reference's
+        # panic) — run in a subprocess to observe the exit code.
+        prog = tmp_path / "fatal.py"
+        prog.write_text(
+            "import sys; sys.path.insert(0, %r)\n"
+            "import mpi_tpu\n"
+            "from mpi_tpu.backends.tcp import TcpNetwork\n"
+            "mpi_tpu.register(TcpNetwork(addrs=[':7777'], addr=':7777'))\n"
+            "mpi_tpu.init()\n"
+            "mpi_tpu.set_errhandler('fatal')\n"
+            "mpi_tpu.send(b'x', 5, 0)\n"
+            "print('UNREACHABLE')\n" % str(REPO))
+        res = subprocess.run([sys.executable, str(prog)],
+                             capture_output=True, text=True, timeout=60)
+        assert res.returncode == 13
+        assert "UNREACHABLE" not in res.stdout
+        assert "aborting" in res.stderr
+
+
+class TestPack:
+    def test_roundtrip_mixed_items(self):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        buf = mpi_tpu.pack(b"raw", "text", 42, arr, None, [1, "two"])
+        got = mpi_tpu.unpack(buf)
+        assert got[0] == b"raw" and got[1] == "text" and got[2] == 42
+        np.testing.assert_array_equal(got[3], arr)
+        assert got[3].dtype == np.float32
+        assert got[4] is None and got[5] == [1, "two"]
+
+    def test_empty_pack(self):
+        assert mpi_tpu.unpack(mpi_tpu.pack()) == ()
+
+    def test_truncated_buffer_raises(self):
+        buf = mpi_tpu.pack("hello")
+        with pytest.raises(MpiError, match="overruns|truncated"):
+            mpi_tpu.unpack(buf[:-2])
+
+    def test_packed_buffer_rides_send(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            if r == 0:
+                mpi_tpu.send(mpi_tpu.pack(1, "x"), 1, 5)
+                out = None
+            else:
+                out = mpi_tpu.unpack(mpi_tpu.receive(0, 5))
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[1] == (1, "x")
+
+
+class TestPersistentCollectives:
+    def test_allreduce_init_restarts(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            box = {"v": r}
+            req = mpi_tpu.allreduce_init(lambda: np.int64(box["v"]))
+            totals = []
+            for round_ in range(3):
+                totals.append(int(req.start().wait()))
+                box["v"] += 10
+            mpi_tpu.finalize()
+            return totals
+
+        res = run_spmd(main, n=4)
+        # round k: sum of (r + 10k) = 6 + 40k
+        assert all(t == [6, 46, 86] for t in res)
+
+    def test_bcast_init_and_barrier_init(self):
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            breq = mpi_tpu.bcast_init(f"from0" if r == 0 else None, root=0)
+            got = breq.start().wait()
+            wall = mpi_tpu.barrier_init()
+            wall.start().wait()
+            wall.start().wait()  # restartable
+            mpi_tpu.finalize()
+            return got
+
+        res = run_spmd(main, n=3)
+        assert res == ["from0"] * 3
+
+
+class TestRegressions:
+    def test_persistent_collective_chains_after_icollective(self):
+        # A persistent start() must sequence after this thread's
+        # outstanding nonblocking collectives (and vice versa), or two
+        # worker threads race into the positional rendezvous and can
+        # pair a barrier with an allreduce across ranks.
+        def main():
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            totals = []
+            wall = mpi_tpu.barrier_init()
+            for _ in range(5):
+                req = mpi_tpu.iallreduce(np.int64(r))  # NOT waited yet
+                wall.start()                            # must chain after
+                totals.append(int(req.wait()))
+                wall.wait()
+            mpi_tpu.finalize()
+            return totals
+
+        res = run_spmd(main, n=4)
+        assert all(t == [6] * 5 for t in res)
+
+    def test_unpack_accepts_wide_memoryview(self):
+        # A memoryview with itemsize > 1 must parse by BYTES: without
+        # the cast("B") normalization, len(view) counts elements and a
+        # valid buffer mis-parses as truncated.
+        buf = mpi_tpu.pack(b"1234567")  # 8 (len) + 1 (kind) + 7 = 16
+        assert len(buf) == 16
+        wide = memoryview(np.frombuffer(buf, dtype=np.uint64))
+        assert wide.itemsize == 8
+        assert mpi_tpu.unpack(wide) == (b"1234567",)
