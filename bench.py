@@ -645,71 +645,93 @@ def main() -> int:
     watchdog = _install_watchdog(deadline) if deadline > 0 else None
 
     # TCP bounce first: subprocesses, no device contention with the rest.
-    # Every completed leg lands in _PARTIALS immediately, so the
-    # watchdog's error line carries whatever finished before a hang.
-    tcp_us = bounce_tcp()
-    try:
-        shm_us = bounce_tcp(proto="shm", port_base=6300)
-    except Exception as exc:  # noqa: BLE001 - leg optional, never fatal
-        shm_us = None
-        print(f"bench: shm bounce leg failed: {exc}", file=sys.stderr)
-    xla_us = bounce_xla()
-    bounce_keys = {
-        "bounce_tcp_us": round(tcp_us, 1),
-        "bounce_xla_us": round(xla_us, 1),
-        "bounce_speedup": round(tcp_us / xla_us, 1),
-    }
-    if shm_us is not None:
-        # Same two-OS-process ping-pong as the TCP leg, frames riding
-        # the native shared-memory rings: the like-for-like transport
-        # comparison (processes + codec + rendezvous on both sides).
-        bounce_keys["bounce_shm_us"] = round(shm_us, 1)
-        bounce_keys["bounce_shm_speedup_vs_tcp"] = round(tcp_us / shm_us, 1)
-    _PARTIALS.update(bounce_keys)
-    bounce_keys.update(bounce_device((1 << 14) if smoke else BOUNCE_SIZE))
-    _PARTIALS.update(bounce_keys)
+    # Every leg runs under _leg(): a completed leg lands in _PARTIALS
+    # immediately (the watchdog's error line carries whatever finished
+    # before a hang), and a FAILED leg — e.g. the TPU tunnel dropping
+    # mid-run, a real failure mode on this box — records a
+    # `<leg>_error` key and the remaining legs still run, so the one
+    # JSON line always appears with everything that did measure.
+    result: dict = {}
+
+    def _leg(label, fn):
+        try:
+            r = fn()
+        except BaseException as exc:  # noqa: BLE001 - line must appear
+            if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                raise
+            r = {f"{label}_error":
+                 f"{type(exc).__name__}: {str(exc)[:300]}"}
+            print(f"bench: {label} leg failed: {exc}", file=sys.stderr)
+        result.update(r)
+        _PARTIALS.update(r)
+        return r
+
+    def bounce_legs():
+        # Each sub-leg flushes to _PARTIALS as it completes, so a later
+        # sub-leg failing (tunnel drop during the xla bounce) cannot
+        # discard numbers already measured.
+        tcp_us = bounce_tcp()
+        keys = {"bounce_tcp_us": round(tcp_us, 1)}
+        _PARTIALS.update(keys)
+        try:
+            shm_us = bounce_tcp(proto="shm", port_base=6300)
+            # Same two-OS-process ping-pong as the TCP leg, frames
+            # riding the native shared-memory rings: the like-for-like
+            # transport comparison (codec + rendezvous on both sides).
+            keys["bounce_shm_us"] = round(shm_us, 1)
+            keys["bounce_shm_speedup_vs_tcp"] = round(tcp_us / shm_us, 1)
+        except Exception as exc:  # noqa: BLE001 - leg optional
+            keys["bounce_shm_error"] = str(exc)[:200]
+        _PARTIALS.update(keys)
+        try:
+            xla_us = bounce_xla()
+            keys["bounce_xla_us"] = round(xla_us, 1)
+            keys["bounce_speedup"] = round(tcp_us / xla_us, 1)
+        except Exception as exc:  # noqa: BLE001 - keep earlier numbers
+            keys["bounce_xla_error"] = str(exc)[:200]
+        return keys
+
+    _leg("bounce", bounce_legs)
+    _leg("bounce_device",
+         lambda: bounce_device((1 << 14) if smoke else BOUNCE_SIZE))
     ar_size = (1 << 20) if smoke else (256 << 20)
     if smoke:
-        result = measure_train_step(d_model=64, n_layers=2, n_heads=4,
-                                    d_ff=128, vocab=128, batch=2, seq=64,
-                                    short=1, long=3)
-        _PARTIALS.update(result)
-        result.update(measure_long_context(
+        _leg("train", lambda: measure_train_step(
+            d_model=64, n_layers=2, n_heads=4, d_ff=128, vocab=128,
+            batch=2, seq=64, short=1, long=3))
+        _leg("long_ctx", lambda: measure_long_context(
             seq=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
             vocab=128, short=1, long=3))
-        _PARTIALS.update(result)
-        result.update(measure_decode(
+        _leg("decode", lambda: measure_decode(
             d_model=64, n_layers=2, n_heads=4, d_ff=128, vocab=128,
             batch=2, prompt_len=16, short=4, long=12))
-        _PARTIALS.update(result)
-        result.update(measure_decode(
+        _leg("decode_int8", lambda: measure_decode(
             d_model=64, n_layers=2, n_heads=4, d_ff=128, vocab=128,
             batch=2, prompt_len=16, short=4, long=12, int8=True))
     else:
-        result = measure_train_step()
-        _PARTIALS.update(result)
-        result.update(measure_long_context())
-        _PARTIALS.update(result)
-        result.update(measure_decode())
-        _PARTIALS.update(result)
-        result.update(measure_decode(int8=True))
-    _PARTIALS.update(result)
-    ar = measure_allreduce(ar_size)
-    _PARTIALS.update(ar)
-    if ar.get("allreduce_devices") == 1:
-        # Single chip: the in-process collective is the identity (keys
-        # are null); measure the real multi-device path on a virtual
-        # 8-device mesh instead.
-        ar.update(_allreduce_on_virtual_mesh(ar_size))
-        _PARTIALS.update(ar)
-    result.update(ar)
-    result.update(bounce_keys)
-    if "--suite" in sys.argv:
-        allreduce_sweep()
+        _leg("train", measure_train_step)
+        _leg("long_ctx", measure_long_context)
+        _leg("decode", measure_decode)
+        _leg("decode_int8", lambda: measure_decode(int8=True))
 
-    mfu = result.pop("mfu_pct")
-    line = {"metric": "train_step_mfu", "value": mfu, "unit": "pct",
-            "vs_baseline": round(mfu / MFU_BASELINE_PCT, 3)}
+    def allreduce_legs():
+        ar = measure_allreduce(ar_size)
+        if ar.get("allreduce_devices") == 1:
+            # Single chip: the in-process collective is the identity
+            # (keys are null); measure the real multi-device path on a
+            # virtual 8-device mesh instead.
+            ar.update(_allreduce_on_virtual_mesh(ar_size))
+        return ar
+
+    _leg("allreduce", allreduce_legs)
+    if "--suite" in sys.argv:
+        _leg("sweep", lambda: allreduce_sweep() or {})
+
+    mfu = result.pop("mfu_pct", None)
+    line = {"metric": "train_step_mfu",
+            "value": 0.0 if mfu is None else mfu, "unit": "pct",
+            "vs_baseline": 0.0 if mfu is None
+            else round(mfu / MFU_BASELINE_PCT, 3)}
     line.update(result)
     if watchdog is not None:
         watchdog.cancel()

@@ -90,6 +90,8 @@ from .api import (
     barrier_init,
     pack,
     unpack,
+    receive_any,
+    abort,
 )
 
 __version__ = "0.1.0"
@@ -156,6 +158,8 @@ __all__ = [
     "barrier_init",
     "pack",
     "unpack",
+    "receive_any",
+    "abort",
     "Intercomm",
     "create_intercomm",
     "DistGraphComm",

@@ -99,6 +99,8 @@ __all__ = [
     "unpack",
     "wtime",
     "wtick",
+    "receive_any",
+    "abort",
 ]
 
 
@@ -476,6 +478,99 @@ def exchange(impl: Interface, data: Any, dest: int, source: int, tag: int,
     if err[0] is not None:
         raise err[0]
     return result[0]
+
+
+def _receive_any_loop(probe: Callable[[int, int], bool],
+                      recv: Callable[[int, int], Any],
+                      cancel: Optional[Callable[[int, int], bool]],
+                      me: int, n: int, tag: int,
+                      timeout: Optional[float],
+                      what: str) -> Tuple[int, Any]:
+    """Shared ANY_SOURCE engine for the facade and :class:`Comm`.
+
+    Probe-then-claim: a probe hit is only a HINT — a sibling
+    ``receive_any`` (or a plain matched receive in another thread) may
+    consume the message between our probe and claim. Blocking
+    unboundedly on the claim would then hang past any timeout, so the
+    claim runs as a short bounded receive: if nothing lands, the
+    registered receive is cancelled (the driver's generation-tagged
+    cancel — the same machinery ``exchange`` uses) and polling resumes.
+    A sibling HOLDING the slot surfaces as :class:`TagError` and is
+    likewise re-polled past."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    # Rotate the probe order by own rank so N concurrent wildcard
+    # receivers don't all stampede the same source first (starting at
+    # self is arbitrary).
+    order = [(me + i) % n for i in range(n)]
+    while True:
+        for src in order:
+            if not probe(src, tag):
+                continue
+            req = Request(lambda s=src: recv(s, tag))
+            try:
+                return src, req.wait(timeout=0.05)
+            except TagError:
+                continue  # a sibling holds this {src, tag} right now
+            except MpiError:
+                if req.test():
+                    raise  # the operation's own error — surface it
+                # Bounded wait expired: the probed message was consumed
+                # by someone else. Cancel our parked receive and move
+                # on; if cancellation lost the race (a sender engaged
+                # after all), the receive is completing — take it.
+                if cancel is not None and cancel(src, tag):
+                    continue
+                return src, req.wait(None)
+        if deadline is not None and time.monotonic() >= deadline:
+            raise MpiError(
+                f"mpi_tpu: {what}(tag={tag}) timed out after "
+                f"{timeout}s with no matching message")
+        time.sleep(0.0005)
+
+
+@_guarded
+def receive_any(tag: int, timeout: Optional[float] = None
+                ) -> Tuple[int, Any]:
+    """Receive a message with ``tag`` from WHICHEVER rank sends first —
+    MPI_Recv with MPI_ANY_SOURCE, returning ``(source, payload)`` (the
+    status' MPI_SOURCE). Works on every driver: available sources are
+    discovered via the driver's non-consuming probe, then the winning
+    message is claimed with a cancellable bounded receive (see
+    :func:`_receive_any_loop` for the race story).
+
+    Concurrency: multiple threads may call ``receive_any`` with the
+    same tag — a message taken by a sibling is re-polled past.
+    ``timeout=None`` blocks forever; on expiry :class:`MpiError`
+    raises with no message consumed. There is no ANY_TAG: tags are
+    unbounded 64-bit values here, so a wildcard over them cannot be
+    probed."""
+    impl = _require_init()
+    _check_tag(tag)
+    cancel = getattr(impl, "cancel_receive", None)
+    return _receive_any_loop(_iprobe_fn(impl), impl.receive, cancel,
+                             impl.rank(), impl.size(), tag, timeout,
+                             "receive_any")
+
+
+def abort(code: int = 1) -> None:
+    """Terminate this rank immediately (MPI_Abort analogue).
+
+    Best effort: the transport is torn down first so peer ranks fail
+    fast — their pending/future operations on this rank poison with a
+    connection error instead of hanging until a timeout — then the
+    process exits with ``code`` (no atexit handlers; the job is being
+    killed). MPI_Abort's whole-job kill reduces to this under the
+    fail-fast doctrine the reference documents (mpi.go:10-14): every
+    surviving rank errors on its next interaction with the dead one."""
+    import sys as _sys
+
+    print(f"mpi_tpu: abort({code})", file=_sys.stderr)
+    try:
+        impl = registered()
+        impl.finalize()
+    except BaseException:  # noqa: BLE001 - exiting anyway
+        pass
+    os._exit(code)
 
 
 @_guarded

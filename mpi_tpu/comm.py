@@ -306,6 +306,20 @@ class Comm:
         """Nonblocking group receive; ``wait()`` returns the payload."""
         return Request(lambda: self.receive(source, tag, out=out))
 
+    def receive_any(self, tag: int, timeout: Optional[float] = None
+                    ) -> Tuple[int, Any]:
+        """Receive ``tag`` from whichever GROUP member sends first —
+        MPI_ANY_SOURCE scoped to this communicator; returns
+        ``(group_source, payload)``. Same engine and concurrency
+        contract as :func:`mpi_tpu.receive_any` (probe-then-claim with
+        cancellable bounded receives); group traffic from other
+        communicators can never match (context isolation)."""
+        from .api import _receive_any_loop
+
+        return _receive_any_loop(
+            self.iprobe, self.receive, self.cancel_receive,
+            self.rank(), self.size(), tag, timeout, "Comm.receive_any")
+
     # -- tag mapping -------------------------------------------------------
 
     def _map_tag(self, tag: int) -> int:

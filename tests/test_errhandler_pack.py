@@ -195,3 +195,141 @@ class TestRegressions:
         wide = memoryview(np.frombuffer(buf, dtype=np.uint64))
         assert wide.itemsize == 8
         assert mpi_tpu.unpack(wide) == (b"1234567",)
+
+
+class TestReceiveAny:
+    def test_any_source_returns_sender(self):
+        # workers send at staggered times; the sink takes them in
+        # arrival order with MPI_ANY_SOURCE semantics.
+        def main():
+            import time as _t
+            mpi_tpu.init()
+            r, n = mpi_tpu.rank(), mpi_tpu.size()
+            if r == 0:
+                got = [mpi_tpu.receive_any(3) for _ in range(n - 1)]
+                out = sorted((src, val) for src, val in got)
+            else:
+                _t.sleep(0.02 * r)
+                mpi_tpu.send(f"w{r}", 0, 3)
+                out = None
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=4)
+        assert res[0] == [(1, "w1"), (2, "w2"), (3, "w3")]
+
+    def test_self_send_matches_any_source(self):
+        def main():
+            import threading
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            t = threading.Thread(
+                target=lambda: mpi_tpu.send(b"self", r, 9), daemon=True)
+            t.start()
+            src, val = mpi_tpu.receive_any(9)
+            t.join(5)
+            mpi_tpu.finalize()
+            return src == r and val == b"self"
+
+        assert all(run_spmd(main, n=2))
+
+    def test_timeout_raises_without_consuming(self):
+        def main():
+            mpi_tpu.init()
+            try:
+                mpi_tpu.receive_any(77, timeout=0.2)
+                out = False
+            except MpiError as exc:
+                out = "timed out" in str(exc)
+            mpi_tpu.finalize()
+            return out
+
+        assert all(run_spmd(main, n=2))
+
+    def test_comm_receive_any_group_scoped(self):
+        from mpi_tpu.comm import comm_world
+
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            evens = w.split(color=r % 2, key=r)
+            if r % 2 == 0:
+                if evens.rank() == 0:
+                    src, val = evens.receive_any(4)
+                    out = (src, val)
+                else:
+                    evens.send(f"g{evens.rank()}", 0, 4)
+                    out = None
+            else:
+                out = None
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=4)
+        assert res[0] == (1, "g1")  # group rank 1 == world rank 2
+
+
+@pytest.mark.integration
+class TestAbort:
+    def test_abort_kills_rank_and_peers_fail_fast(self, tmp_path):
+        # rank 1 aborts; rank 0's pending receive must fail with a
+        # connection error well before the init timeout, and the
+        # launcher must propagate rank 1's abort code.
+        prog = tmp_path / "ab.py"
+        prog.write_text(
+            "import sys, time\n"
+            "sys.path.insert(0, %r)\n"
+            "import mpi_tpu\n"
+            "mpi_tpu.init()\n"
+            "r = mpi_tpu.rank()\n"
+            "if r == 1:\n"
+            "    time.sleep(0.5)\n"
+            "    mpi_tpu.abort(7)\n"
+            "t0 = time.monotonic()\n"
+            "try:\n"
+            "    mpi_tpu.receive(1, 0)\n"
+            "    sys.exit(50)  # must not succeed\n"
+            "except Exception:\n"
+            "    dt = time.monotonic() - t0\n"
+            "    sys.exit(0 if dt < 20 else 51)\n" % str(REPO))
+        res = subprocess.run(
+            [sys.executable, "-m", "mpi_tpu.launch.mpirun",
+             "--port-base", "7551", "--timeout", "30", "2", str(prog)],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert res.returncode == 7, (res.returncode, res.stderr[-400:])
+        assert "abort(7)" in res.stderr
+
+    def test_concurrent_wildcards_one_message_timeout_respected(self):
+        # Two wildcard receivers, ONE message: the loser must honor its
+        # timeout (not block forever inside a stale claimed receive)
+        # and leave nothing consumed.
+        def main():
+            import threading
+            mpi_tpu.init()
+            r = mpi_tpu.rank()
+            if r == 1:
+                mpi_tpu.send(b"only", 0, 11)
+                out = None
+            else:
+                results = []
+
+                def taker():
+                    try:
+                        results.append(("ok", mpi_tpu.receive_any(
+                            11, timeout=3.0)))
+                    except MpiError as exc:
+                        results.append(("timeout", str(exc)))
+
+                ts = [threading.Thread(target=taker) for _ in range(2)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(20)
+                    assert not t.is_alive(), "wildcard receiver hung"
+                out = sorted(kind for kind, _ in results)
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[0] == ["ok", "timeout"]
