@@ -25,6 +25,7 @@ from .transformer import (
 from .moe import init_moe_params, moe_ffn, moe_specs
 from .generate import decode_step, generate, prefill
 from .quant import QTensor, dequantize, quantize, quantize_params
+from .speculative import generate_lookahead
 from .pipeline_lm import (
     forward_pipelined,
     init_pipelined_params,
@@ -54,6 +55,7 @@ __all__ = [
     "prefill",
     "decode_step",
     "generate",
+    "generate_lookahead",
     "forward_pipelined",
     "init_pipelined_params",
     "make_pipelined_train_step",

@@ -1,0 +1,159 @@
+"""Prompt-lookup speculative decoding — lossless greedy acceleration.
+
+Autoregressive decode is one forward per token; speculative decoding
+feeds several *drafted* tokens through one forward and keeps the prefix
+the model agrees with, so the cost per accepted token drops while the
+output stays EXACTLY the greedy decode (acceptance compares the draft
+against the model's own argmax — a mismatch truncates the round, so no
+approximation enters). The draft here is **prompt lookup** (n-gram
+retrieval): find the most recent earlier occurrence of the last
+``ngram`` tokens and propose whatever followed it — free to compute, no
+draft model, and highly effective on inputs with repetition
+(summarisation, code, chat history).
+
+Exactness caveat: acceptance compares against THIS path's greedy
+argmaxes, so the output is self-consistently greedy by construction;
+it equals ``generate()``'s output whenever argmax is stable across the
+two paths' forward shapes (s_q = draft_len+1 here vs 1 there). That
+always holds in the f32 test regime; under bf16 TPU matmuls a
+near-exact logit tie could reduce in a different order and flip — the
+usual caveat for any batched-verification speculative decoder.
+
+tpu-first shape discipline: the whole loop is ``lax.while_loop`` under
+``jit`` with static shapes — the token buffer is preallocated, the
+n-gram search is a vectorized window match over the buffer (no host
+round trips), every round feeds exactly ``draft_len + 1`` tokens, and
+variable acceptance is a masked buffer blend rather than a dynamic
+shape. Batched inputs vmap the single-row engine; rows finish at their
+own pace under a ``produced`` freeze mask (the standard vmap-of-while
+treatment).
+
+Verification math: with ``pending`` = the committed-but-not-yet-fed
+token for position ``n_valid``, each round feeds ``[pending, d_1..d_m]``
+at ``n_valid``, yielding logits whose argmaxes ``g_1..g_{m+1}`` are the
+greedy continuations. ``k`` = length of the longest prefix with
+``d_i == g_i``; the round commits ``pending, d_1..d_k`` and the new
+pending becomes ``g_{k+1}`` (the "bonus" token — even a fully rejected
+draft still nets one token, so progress ≥ 1 per round and worst case
+equals plain decode with ``m`` wasted lanes of an already-launched
+matmul). Rejected cache rows beyond the new ``n_valid`` are never
+attended (the causal position mask) and are overwritten by the next
+round's write at the same offsets.
+
+No reference analogue (btracey/mpi has no models).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .generate import _forward_cached, prefill
+from .transformer import TransformerConfig
+
+__all__ = ["generate_lookahead"]
+
+
+def _find_draft(buf: jax.Array, n_valid: jax.Array, ngram: int,
+                draft_len: int) -> jax.Array:
+    """Prompt-lookup draft: the ``draft_len`` tokens that followed the
+    most recent earlier occurrence of the last ``ngram`` committed
+    tokens. ``buf`` is the (L,) token buffer, positions >= n_valid are
+    garbage. Returns (draft_len,) int32 — possibly garbage when no
+    match exists or the match runs past ``n_valid``; verification
+    rejects garbage for free, so no validity flag is needed."""
+    L = buf.shape[0]
+    # key = buf[n_valid-ngram : n_valid], gathered at dynamic offsets
+    key = jax.vmap(
+        lambda j: buf[(n_valid - ngram + j) % L])(jnp.arange(ngram))
+    # window t matches iff buf[t + j] == key[j] for all j; the window
+    # START must sit strictly before the key's start (n_valid - ngram),
+    # which excludes the trivial self-match while still admitting
+    # key-overlapping matches (standard prompt-lookup behavior).
+    idx = jnp.arange(L)
+
+    def win_eq(j):
+        shifted = jnp.roll(buf, -j)          # shifted[t] = buf[t + j]
+        return shifted == key[j]
+
+    eq = jnp.all(jnp.stack([win_eq(j) for j in range(ngram)]), axis=0)
+    valid = idx < jnp.maximum(n_valid - ngram, 0)
+    cand = jnp.where(eq & valid, idx, -1)
+    p = jnp.max(cand)                         # most recent match start
+    start = jnp.where(p >= 0, p + ngram, 0)   # draft follows the match
+    return jax.vmap(
+        lambda j: buf[(start + j) % L])(jnp.arange(draft_len))
+
+
+def generate_lookahead(params: Any, prompt: jax.Array,
+                       cfg: TransformerConfig, max_new_tokens: int,
+                       draft_len: int = 4, ngram: int = 2) -> jax.Array:
+    """Greedy generation, bit-identical to
+    :func:`mpi_tpu.models.generate` at ``temperature=0``, accelerated
+    by prompt-lookup speculation. ``prompt`` is (b, s); returns
+    (b, max_new_tokens). ``draft_len`` tokens are verified per forward;
+    ``ngram`` is the lookup key length."""
+    b, s = prompt.shape
+    if ngram < 1 or draft_len < 1:
+        raise ValueError("mpi_tpu: ngram and draft_len must be >= 1")
+    if ngram > s:
+        raise ValueError(
+            f"mpi_tpu: ngram {ngram} longer than the prompt ({s})")
+    # Every round may write draft_len + 1 positions starting at most at
+    # prompt + max_new - 1; the cache/buffer must hold the overhang.
+    need = s + max_new_tokens + draft_len + 1
+    if need > cfg.max_seq:
+        raise ValueError(
+            f"mpi_tpu: prompt {s} + {max_new_tokens} new + draft "
+            f"overhang {draft_len + 1} needs max_seq >= {need}, have "
+            f"{cfg.max_seq}")
+
+    L = cfg.max_seq
+    m = draft_len
+
+    def row(prompt_row: jax.Array) -> jax.Array:
+        last_logits, cache = prefill(params, prompt_row[None], cfg)
+        pending = jnp.argmax(last_logits[0], axis=-1).astype(jnp.int32)
+        buf = jnp.zeros((L,), jnp.int32).at[:s].set(prompt_row)
+
+        def cond(state):
+            _, _, _, _, produced = state
+            return produced < max_new_tokens
+
+        def body(state):
+            buf, cache, n_valid, pending, produced = state
+            # The pending token is committed: place it so the n-gram
+            # key (which includes it) reads from the buffer.
+            buf = lax.dynamic_update_slice(buf, pending[None], (n_valid,))
+            draft = _find_draft(buf, n_valid + 1, ngram, m)
+            fed = jnp.concatenate([pending[None], draft])     # (m+1,)
+            logits, new_cache = _forward_cached(
+                params, fed[None], cache, n_valid, cfg)
+            greedy = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+            # greedy[i] continues after seq[i]; accept draft[i] while it
+            # equals greedy[i] (exactly the greedy rule).
+            match = draft == greedy[:m]
+            k = jnp.argmin(jnp.concatenate(
+                [match, jnp.zeros((1,), bool)]).astype(jnp.int32)
+            ).astype(jnp.int32)
+            # Commit pending + accepted drafts, but never past the
+            # requested token count: freeze the surplus. int32 pinned:
+            # under x64 the index arithmetic would widen the carry.
+            take = jnp.minimum(k + 1, max_new_tokens - produced
+                               ).astype(jnp.int32)
+            seg = lax.dynamic_slice(buf, (n_valid,), (m + 1,))
+            # The committed tokens ARE the fed sequence's accepted prefix.
+            write = jnp.where(jnp.arange(m + 1) < take, fed, seg)
+            buf = lax.dynamic_update_slice(buf, write, (n_valid,))
+            new_pending = greedy[k]
+            return (buf, new_cache, n_valid + take, new_pending,
+                    produced + take)
+
+        state = (buf, cache, jnp.int32(s), pending, jnp.int32(0))
+        buf, _, _, _, _ = lax.while_loop(cond, body, state)
+        return lax.dynamic_slice(buf, (s,), (max_new_tokens,))
+
+    return jax.vmap(row)(prompt.astype(jnp.int32))

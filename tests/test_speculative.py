@@ -1,0 +1,93 @@
+"""Prompt-lookup speculative decoding tests (models/speculative.py).
+
+The invariant under test is strong: for ANY model and prompt, the
+speculative output must be bit-identical to plain greedy decode —
+speculation is an execution strategy, not an approximation. Repetitive
+prompts exercise high acceptance, random prompts high rejection; both
+must agree exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_tpu.models import TransformerConfig, generate, init_params
+from mpi_tpu.models.speculative import generate_lookahead
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64, max_seq=96)
+
+
+def _params(seed=0, cfg=CFG):
+    return init_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _prompt(rows, seed=0, s=16, vocab=CFG.vocab):
+    return jnp.asarray(np.random.default_rng(seed).integers(
+        0, vocab, (rows, s)), dtype=jnp.int32)
+
+
+class TestGreedyParity:
+    def test_random_prompt_exact_match(self):
+        params = _params()
+        prompt = _prompt(2)
+        ref = generate(params, prompt, CFG, 20)
+        spec = generate_lookahead(params, prompt, CFG, 20)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+
+    def test_repetitive_prompt_exact_match(self):
+        # High-acceptance regime: the prompt is a repeated phrase, so
+        # lookup drafts are often right — output must still be exact.
+        params = _params(1)
+        phrase = np.asarray([5, 9, 2, 7, 11, 3], dtype=np.int32)
+        prompt = jnp.asarray(np.tile(phrase, 4)[None].repeat(3, 0))
+        ref = generate(params, prompt, CFG, 24)
+        spec = generate_lookahead(params, prompt, CFG, 24,
+                                  draft_len=6, ngram=3)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+
+    @pytest.mark.parametrize("draft_len,ngram", [(1, 1), (3, 2), (8, 4)])
+    def test_parameter_grid_exact(self, draft_len, ngram):
+        params = _params(2)
+        prompt = _prompt(1, seed=3, s=12)
+        ref = generate(params, prompt, CFG, 16)
+        spec = generate_lookahead(params, prompt, CFG, 16,
+                                  draft_len=draft_len, ngram=ngram)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+
+    def test_rope_model_exact(self):
+        cfg = TransformerConfig(vocab=48, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq=80,
+                                n_kv_heads=2)  # GQA + rope (default)
+        params = _params(4, cfg)
+        prompt = _prompt(2, seed=5, s=10, vocab=cfg.vocab)
+        ref = generate(params, prompt, cfg, 18)
+        spec = generate_lookahead(params, prompt, cfg, 18)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+
+    def test_jit_compiles_once_and_matches(self):
+        params = _params()
+        prompt = _prompt(2, seed=7)
+        fn = jax.jit(lambda p, x: generate_lookahead(p, x, CFG, 12))
+        spec = fn(params, prompt)
+        ref = generate(params, prompt, CFG, 12)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+
+
+class TestValidation:
+    def test_max_seq_overhang_enforced(self):
+        params = _params()
+        prompt = _prompt(1, s=16)
+        with pytest.raises(ValueError, match="max_seq"):
+            generate_lookahead(params, prompt, CFG, 96)
+
+    def test_ngram_longer_than_prompt_rejected(self):
+        params = _params()
+        with pytest.raises(ValueError, match="ngram"):
+            generate_lookahead(params, _prompt(1, s=4), CFG, 4, ngram=5)
+
+    def test_bad_draft_len_rejected(self):
+        params = _params()
+        with pytest.raises(ValueError, match="draft_len|>= 1"):
+            generate_lookahead(params, _prompt(1), CFG, 4, draft_len=0)
