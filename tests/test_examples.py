@@ -73,3 +73,18 @@ class TestCommGroups:
         # mismatch); spot-check one line of the per-rank report.
         assert "rank 3 = grid (1, 1)  row_sum=5.0  col_sum=4.0" \
             in res.stdout
+
+
+@pytest.mark.integration
+class TestServe:
+    def test_serve_demo_all_paths_agree(self):
+        # single process (no launcher): decode + int8 + speculative,
+        # exiting nonzero if speculative output diverges from greedy.
+        res = subprocess.run(
+            [sys.executable, "examples/serve.py", "--devices", "1",
+             "--tokens", "24", "--prompt-len", "16"],
+            cwd=REPO, capture_output=True, text=True, timeout=300)
+        # the divergence report lands on stdout; surface both streams
+        assert res.returncode == 0, (res.stdout[-400:], res.stderr[-400:])
+        assert "speculative == greedy: True" in res.stdout
+        assert "int8 output valid: True" in res.stdout
