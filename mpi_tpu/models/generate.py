@@ -53,6 +53,20 @@ def _attend_cached(q, k_cache, v_cache, n_valid, cfg):
     than materialised group x larger, so decode keeps GQA's bandwidth
     and peak-memory win (the point of the smaller cache)."""
     b, s_q, h, hd = q.shape
+    if cfg.decode_attention not in ("dense", "flash"):
+        # Same loud-unknown stance as attention_impl: silently falling
+        # back would hide a misconfiguration on the hot path.
+        raise ValueError(
+            f"mpi_tpu: unknown decode_attention "
+            f"{cfg.decode_attention!r}: expected dense|flash")
+    if s_q == 1 and cfg.decode_attention == "flash":
+        # One-query steps take the fused Pallas path: a single VMEM
+        # pass over the cache with online softmax, GQA-native.
+        from ..ops.decode_attention import flash_decode_attention
+
+        out = flash_decode_attention(q[:, 0], k_cache, v_cache,
+                                     jnp.asarray(n_valid, jnp.int32))
+        return out[:, None]
     kv = cfg.kv_heads
     group = h // kv
     qg = q.reshape(b, s_q, kv, group, hd)

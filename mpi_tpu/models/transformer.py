@@ -71,6 +71,12 @@ class TransformerConfig:
     # per head group). The ring/zigzag/ulysses family needs a mesh
     # with 'sp'.
     attention_impl: str = "dense"
+    # Decode-time (KV-cache) attention: "dense" (jnp einsum chain, the
+    # oracle) | "flash" (Pallas flash-decode kernel — one VMEM pass
+    # over the cache per step, ops/decode_attention.py). Applies to
+    # single-token decode steps only; prefill always uses the dense
+    # cached path.
+    decode_attention: str = "dense"
     # Mixture-of-Experts FFN (0 = dense). Experts shard over the 'ep'
     # mesh axis (mpi_tpu.models.moe); aux load-balance loss is added to
     # the training objective with coefficient moe_aux_coef. moe_top_k

@@ -15,6 +15,7 @@ from .attention import (
     flash_chunk_bwd,
     merge_attention_chunks,
 )
+from .decode_attention import flash_decode_attention
 from .ring_collectives import (
     ring_allgather,
     ring_allgather_sharded,
@@ -27,6 +28,7 @@ __all__ = [
     "blockwise_attention",
     "flash_attention",
     "flash_attention_with_lse",
+    "flash_decode_attention",
     "flash_chunk_bwd",
     "merge_attention_chunks",
     "ring_allgather",
