@@ -581,6 +581,28 @@ def _allreduce_on_virtual_mesh(size_bytes: int) -> dict:
             if k.endswith("_gbps") or k.endswith("_p50_us")}
 
 
+def _device_preflight(timeout_s: float = 300.0):
+    """(ok, why): can a subprocess initialize the default JAX backend
+    and run one tiny device op? Run out of process so neither an
+    instant backend failure nor a hung tunnel touches this process's
+    JAX state. The generous timeout covers a cold first compile
+    (~20-40 s through the tunnel)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "print(float(jnp.ones((128, 128)).sum()))"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, f"device op hung for {timeout_s:.0f}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()
+        return False, tail[-1] if tail else f"rc={proc.returncode}"
+    return True, ""
+
+
 # Measurements already completed this run — the watchdog ships them in
 # its error line so a late device hang doesn't discard the host-side
 # legs that did finish.
@@ -642,6 +664,35 @@ def main() -> int:
     smoke = "--smoke" in sys.argv
 
     deadline = float(os.environ.get("MPI_TPU_BENCH_DEADLINE_S", "2400"))
+
+    tpu_fallback = {}
+    if "--platform" not in sys.argv:
+        # Preflight the accelerator IN A SUBPROCESS (a hung tunnel would
+        # otherwise wedge this process before any leg runs — both
+        # observed failure modes: instant UNAVAILABLE and indefinite
+        # hang). On failure, fall back to CPU with explicit provenance
+        # so the run still yields a complete, honestly-labelled line.
+        # The probe never outlives the overall deadline (line contract).
+        limit = 300.0 if deadline <= 0 else min(300.0, deadline / 2)
+        ok, why = _device_preflight(timeout_s=limit)
+        if not ok:
+            from mpi_tpu.utils.platform import force_platform
+
+            force_platform("cpu", 1)
+            tpu_fallback = {
+                "tpu_unreachable": True,
+                "tpu_preflight_error": why[:300],
+                "platform_note": "accelerator preflight failed; device "
+                                 "legs measured on CPU at smoke sizes",
+            }
+            print(f"bench: accelerator preflight failed ({why[:120]}); "
+                  f"falling back to CPU at smoke sizes", file=sys.stderr)
+
+    # Full-size model legs are sized for the chip; on the CPU fallback
+    # they would blow the watchdog, so degrade to the smoke shapes
+    # (the provenance keys above mark the line accordingly).
+    smoke = smoke or bool(tpu_fallback)
+
     watchdog = _install_watchdog(deadline) if deadline > 0 else None
 
     # TCP bounce first: subprocesses, no device contention with the rest.
@@ -732,6 +783,7 @@ def main() -> int:
             "value": 0.0 if mfu is None else mfu, "unit": "pct",
             "vs_baseline": 0.0 if mfu is None
             else round(mfu / MFU_BASELINE_PCT, 3)}
+    line.update(tpu_fallback)
     line.update(result)
     if watchdog is not None:
         watchdog.cancel()

@@ -156,7 +156,10 @@ def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
         functools.partial(_decode_kernel, scale=scale, block_k=bk, t=t),
         grid=(b, kv, nk),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda bi, kvi, ki: (0, 0)),
+            # Scalar in SMEM: it feeds the pl.when block-skip predicate,
+            # and scalar control flow is what SMEM is for (a VMEM load
+            # is not a reliable predicate source under Mosaic).
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, rows, hd),
                          lambda bi, kvi, ki: (bi, kvi, 0, 0)),
             pl.BlockSpec((1, bk, 1, hd),
