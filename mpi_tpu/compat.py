@@ -1,0 +1,386 @@
+"""mpi4py-style compatibility layer: ``from mpi_tpu.compat import MPI``.
+
+The reference's users write against a Go MPI-like API; the Python
+world's lingua franca for the same programs is mpi4py. This shim lets
+an mpi4py-style script run on this framework by changing ONE line —
+
+    from mpi4py import MPI          ->   from mpi_tpu.compat import MPI
+
+— after which ``MPI.COMM_WORLD``, ``Get_rank``/``Get_size``, lowercase
+pickle-based p2p/collectives (``send``/``recv``/``bcast``/``allreduce``
+/...), uppercase buffer-based ``Send``/``Recv``/``Bcast``/``Allreduce``
+(numpy arrays; the capital-letter convention for typed buffers),
+``Split``/``Dup``/``Free``, nonblocking ``isend``/``irecv`` returning
+``wait()``-able requests, ``ANY_SOURCE`` receives with a ``Status``,
+and the op constants (``SUM``/``PROD``/``MIN``/``MAX``) behave as an
+mpi4py user expects — lowered onto whichever driver is active (tcp,
+xla, hybrid), so "mpi4py code" transparently runs its collectives as
+compiled XLA programs on TPU.
+
+Scope honesty: this is the commonly-used core surface, not all of
+mpi4py (no derived datatypes beyond numpy dtypes, no dynamic process
+management, no passive-target RMA — the native API has the supported
+RMA surface in :mod:`mpi_tpu.window`). ``COMM_WORLD`` auto-initializes
+the framework on first use, matching mpi4py's import-time init
+ergonomics; call ``MPI.Finalize()`` (or ``mpi_tpu.finalize()``) at the
+end as usual. No reference analogue (pure framework-usability work).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from . import api
+from .comm import Comm as _NativeComm, comm_world
+
+__all__ = ["MPI"]
+
+
+class Status:
+    """Receive status (mpi4py ``MPI.Status``): filled by ``recv``/
+    ``Recv``/``probe`` with the actual source and tag."""
+
+    def __init__(self) -> None:
+        self.source: int = -1
+        self.tag: int = -1
+
+    def Get_source(self) -> int:
+        return self.source
+
+    def Get_tag(self) -> int:
+        return self.tag
+
+
+class Request:
+    """Wraps the native request; mpi4py method names."""
+
+    def __init__(self, inner: "api.Request"):
+        self._inner = inner
+
+    def wait(self, status: Optional[Status] = None) -> Any:
+        return self._inner.wait()
+
+    Wait = wait
+
+    def test(self) -> bool:
+        return self._inner.test()
+
+    Test = test
+
+
+class _AnySourceRequest(Request):
+    """irecv(ANY_SOURCE): the native op yields (source, payload);
+    ``wait(status)`` fills the status with the real sender — the
+    information mpi4py callers reply to — and returns the payload."""
+
+    def wait(self, status: Optional[Status] = None) -> Any:
+        src, obj = self._inner.wait()
+        if status is not None:
+            status.source = src
+        return obj
+
+    Wait = wait
+
+
+class Comm:
+    """mpi4py-flavoured view over a native communicator."""
+
+    def __init__(self, native: _NativeComm):
+        self._c = native
+
+    def __eq__(self, other: Any) -> bool:
+        # Wrapper objects are cheap views; communicator identity is the
+        # underlying (driver, context, membership) — so fresh wrappers
+        # of one communicator compare equal, as mpi4py code expects of
+        # `comm == MPI.COMM_WORLD`.
+        if not isinstance(other, Comm):
+            return NotImplemented
+        return (self._c._impl is other._c._impl
+                and self._c.context == other._c.context
+                and self._c.members == other._c.members)
+
+    def __hash__(self) -> int:
+        return hash((id(self._c._impl), self._c.context, self._c.members))
+
+    # -- identity -----------------------------------------------------------
+
+    def Get_rank(self) -> int:
+        return self._c.rank()
+
+    def Get_size(self) -> int:
+        return self._c.size()
+
+    rank = property(Get_rank)
+    size = property(Get_size)
+
+    @property
+    def native(self) -> _NativeComm:
+        """The underlying :class:`mpi_tpu.comm.Comm` (escape hatch)."""
+        return self._c
+
+    # -- pickle-based p2p (lowercase, mpi4py semantics) ---------------------
+    #
+    # Tag wildcards do not exist here (tags are unbounded i64, so an
+    # ANY_TAG match cannot be probed): receive-side tags default to 0
+    # — matching send's default, so default-tag scripts pair up — and
+    # passing ANY_TAG raises loudly instead of silently hanging.
+
+    def send(self, obj: Any, dest: int, tag: int = 0) -> None:
+        self._c.send(obj, dest, tag)
+
+    def recv(self, source: int = -1, tag: int = 0,
+             status: Optional[Status] = None) -> Any:
+        _check_tag_not_wild(tag, "recv")
+        if source == ANY_SOURCE:
+            src, obj = self._c.receive_any(tag)
+        else:
+            src, obj = source, self._c.receive(source, tag)
+        if status is not None:
+            status.source, status.tag = src, tag
+        return obj
+
+    def sendrecv(self, sendobj: Any, dest: int, sendtag: int = 0,
+                 recvbuf: Any = None, source: int = -1,
+                 recvtag: Optional[int] = None,
+                 status: Optional[Status] = None) -> Any:
+        """mpi4py parameter ORDER (recvbuf is the 4th positional — it
+        is accepted and ignored, as the pickle path needs no scratch
+        buffer). ``recvtag`` defaults to ``sendtag``; ANY_TAG raises."""
+        if recvtag is None:
+            recvtag = sendtag
+        _check_tag_not_wild(recvtag, "sendrecv")
+        if source == ANY_SOURCE:
+            # wildcard source: concurrent tagged send + ANY_SOURCE recv
+            sreq = self._c.isend(sendobj, dest, sendtag)
+            src, obj = self._c.receive_any(recvtag)
+            sreq.wait()
+        else:
+            if sendtag == recvtag:
+                obj = self._c.sendrecv(sendobj, dest=dest, source=source,
+                                       tag=sendtag)
+            else:
+                sreq = self._c.isend(sendobj, dest, sendtag)
+                obj = self._c.receive(source, recvtag)
+                sreq.wait()
+            src = source
+        if status is not None:
+            status.source, status.tag = src, recvtag
+        return obj
+
+    def isend(self, obj: Any, dest: int, tag: int = 0) -> Request:
+        return Request(self._c.isend(obj, dest, tag))
+
+    def irecv(self, source: int = -1, tag: int = 0) -> Request:
+        _check_tag_not_wild(tag, "irecv")
+        if source == ANY_SOURCE:
+            return _AnySourceRequest(api.Request(
+                lambda: self._c.receive_any(tag)))
+        return Request(self._c.irecv(source, tag))
+
+    def probe(self, source: int = -1, tag: int = 0,
+              status: Optional[Status] = None) -> bool:
+        """Blocking probe; ``source`` defaults to ANY_SOURCE as in
+        mpi4py (polls every rank until a matching message appears)."""
+        import time as _time
+
+        _check_tag_not_wild(tag, "probe")
+        if source != ANY_SOURCE:
+            self._c.probe(source, tag)
+            src = source
+        else:
+            while True:
+                src = self._iprobe_any(tag)
+                if src is not None:
+                    break
+                _time.sleep(0.0005)
+        if status is not None:
+            status.source, status.tag = src, tag
+        return True
+
+    def iprobe(self, source: int = -1, tag: int = 0,
+               status: Optional[Status] = None) -> bool:
+        _check_tag_not_wild(tag, "iprobe")
+        if source != ANY_SOURCE:
+            hit = self._c.iprobe(source, tag)
+            src = source
+        else:
+            src = self._iprobe_any(tag)
+            hit = src is not None
+        if hit and status is not None:
+            status.source, status.tag = src, tag
+        return hit
+
+    def _iprobe_any(self, tag: int) -> Optional[int]:
+        for src in range(self._c.size()):
+            if self._c.iprobe(src, tag):
+                return src
+        return None
+
+    # -- buffer-based p2p (uppercase: numpy arrays, no repickling) ----------
+
+    def Send(self, buf: Any, dest: int, tag: int = 0) -> None:
+        self._c.send(np.ascontiguousarray(buf), dest, tag)
+
+    def Recv(self, buf: Any, source: int = -1, tag: int = 0,
+             status: Optional[Status] = None) -> None:
+        _check_tag_not_wild(tag, "Recv")
+        if source == ANY_SOURCE:
+            src, got = self._c.receive_any(tag)
+        else:
+            src, got = source, self._c.receive(source, tag)
+        np.copyto(np.asarray(buf), got)
+        if status is not None:
+            status.source, status.tag = src, tag
+
+    # -- collectives --------------------------------------------------------
+
+    def barrier(self) -> None:
+        self._c.barrier()
+
+    Barrier = barrier
+
+    def bcast(self, obj: Any = None, root: int = 0) -> Any:
+        return self._c.bcast(obj, root=root)
+
+    def Bcast(self, buf: Any, root: int = 0) -> None:
+        got = self._c.bcast(
+            np.ascontiguousarray(buf) if self.Get_rank() == root else None,
+            root=root)
+        np.copyto(np.asarray(buf), got)
+
+    def allreduce(self, sendobj: Any, op: "Op" = None) -> Any:
+        return self._c.allreduce(sendobj, op=_op(op))
+
+    def Allreduce(self, sendbuf: Any, recvbuf: Any,
+                  op: "Op" = None) -> None:
+        got = self._c.allreduce(np.ascontiguousarray(sendbuf),
+                                op=_op(op))
+        np.copyto(np.asarray(recvbuf), got)
+
+    def reduce(self, sendobj: Any, op: "Op" = None,
+               root: int = 0) -> Optional[Any]:
+        return self._c.reduce(sendobj, root=root, op=_op(op))
+
+    def gather(self, sendobj: Any, root: int = 0) -> Optional[List[Any]]:
+        return self._c.gather(sendobj, root=root)
+
+    def allgather(self, sendobj: Any) -> List[Any]:
+        return self._c.allgather(sendobj)
+
+    def scatter(self, sendobj: Optional[List[Any]] = None,
+                root: int = 0) -> Any:
+        return self._c.scatter(sendobj, root=root)
+
+    def alltoall(self, sendobj: List[Any]) -> List[Any]:
+        return self._c.alltoall(sendobj)
+
+    def scan(self, sendobj: Any, op: "Op" = None) -> Any:
+        return self._c.scan(sendobj, op=_op(op))
+
+    def exscan(self, sendobj: Any, op: "Op" = None) -> Optional[Any]:
+        return self._c.exscan(sendobj, op=_op(op))
+
+    # -- construction -------------------------------------------------------
+
+    def Split(self, color: Optional[int] = 0, key: int = 0
+              ) -> Optional["Comm"]:
+        child = self._c.split(color=color, key=key)
+        return None if child is None else Comm(child)
+
+    def Dup(self) -> "Comm":
+        return Comm(self._c.dup())
+
+    def Free(self) -> None:
+        self._c.free()
+
+    def Abort(self, errorcode: int = 1) -> None:
+        api.abort(errorcode)
+
+
+class Op:
+    """Reduction-op constant (SUM/PROD/MIN/MAX)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"MPI.{self.name.upper()}"
+
+
+def _op(op: Optional[Op]) -> Any:
+    if op is None:
+        return "sum"
+    if isinstance(op, Op):
+        return op.name
+    return op  # a callable or native op string passes straight through
+
+
+ANY_SOURCE = -1
+ANY_TAG = -2
+
+
+def _check_tag_not_wild(tag: int, what: str) -> None:
+    if tag == ANY_TAG:
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what} with MPI.ANY_TAG is not supported "
+            f"(tags are unbounded 64-bit values here, so a tag wildcard "
+            f"cannot be probed); pass the sender's tag explicitly — "
+            f"receive-side tags default to 0, matching send's default")
+
+
+class _MPI:
+    """The module-object stand-in mpi4py scripts address as ``MPI``."""
+
+    ANY_SOURCE = ANY_SOURCE
+    ANY_TAG = ANY_TAG
+    SUM = Op("sum")
+    PROD = Op("prod")
+    MIN = Op("min")
+    MAX = Op("max")
+    Status = Status
+    Request = Request
+    Comm = Comm
+
+    _world_cache: Optional[Comm] = None
+
+    @property
+    def COMM_WORLD(self) -> Comm:
+        # mpi4py initializes at import; the nearest safe analogue is
+        # lazy init on first world access. The wrapper is cached so
+        # `comm is MPI.COMM_WORLD` identity checks behave like
+        # mpi4py's singleton (and __eq__ covers fresh wrappers).
+        if not self.Is_initialized():
+            api.init()
+            self._world_cache = None
+        if self._world_cache is None \
+                or self._world_cache._c._impl is not api.registered():
+            self._world_cache = Comm(comm_world())
+        return self._world_cache
+
+    def Init(self) -> None:
+        if not self.Is_initialized():
+            api.init()
+
+    def Finalize(self) -> None:
+        if self.Is_initialized():
+            api.finalize()
+        self._world_cache = None
+
+    def Is_initialized(self) -> bool:
+        return api._init_count > 0
+
+    def Get_processor_name(self) -> str:
+        import socket
+
+        return socket.gethostname()
+
+    def Wtime(self) -> float:
+        return api.wtime()
+
+    def Wtick(self) -> float:
+        return api.wtick()
+
+
+MPI = _MPI()

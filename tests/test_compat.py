@@ -1,0 +1,280 @@
+"""mpi4py compatibility shim tests (mpi_tpu/compat.py).
+
+The headline check runs a canonical mpi4py tutorial-style script with
+ONLY the import line changed, through the real launcher — the drop-in
+claim, executed. The rest covers the surface piecewise over the xla
+SPMD harness.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mpi_tpu import api
+from mpi_tpu.backends.xla import run_spmd
+
+from conftest import _free_port_block
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    api._reset_for_testing()
+    yield
+    api._reset_for_testing()
+
+
+def _world():
+    from mpi_tpu.compat import MPI
+
+    return MPI, MPI.COMM_WORLD
+
+
+class TestBasics:
+    def test_rank_size_and_lazy_init(self):
+        def main():
+            from mpi_tpu.compat import MPI
+
+            comm = MPI.COMM_WORLD  # lazy init happens here
+            out = (comm.Get_rank(), comm.Get_size(), comm.rank, comm.size,
+                   MPI.Is_initialized())
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=3)
+        assert [r[0] for r in res] == [0, 1, 2]
+        assert all(r[1] == 3 and r[2] == r[0] and r[3] == 3 and r[4]
+                   for r in res)
+
+    def test_pickle_p2p_and_any_source_status(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            if r == 0:
+                st = MPI.Status()
+                got = comm.recv(source=MPI.ANY_SOURCE, tag=7, status=st)
+                out = (got, st.Get_source(), st.Get_tag())
+            else:
+                comm.send({"from": r}, dest=0, tag=7)
+                out = None
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[0] == ({"from": 1}, 1, 7)
+
+    def test_buffer_send_recv(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            if r == 0:
+                comm.Send(np.arange(8, dtype=np.float64), dest=1, tag=1)
+                out = None
+            else:
+                buf = np.empty(8, dtype=np.float64)
+                st = MPI.Status()
+                comm.Recv(buf, source=0, tag=1, status=st)
+                out = (buf.copy(), st.Get_source())
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        np.testing.assert_array_equal(res[1][0], np.arange(8.0))
+        assert res[1][1] == 0
+
+    def test_collectives_and_ops(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            total = comm.allreduce(r + 1, op=MPI.SUM)
+            mx = comm.allreduce(r, op=MPI.MAX)
+            data = comm.bcast({"v": 42} if r == 0 else None, root=0)
+            ranks = comm.allgather(r)
+            buf = np.full(4, float(r))
+            out = np.empty(4)
+            comm.Allreduce(buf, out, op=MPI.SUM)
+            MPI.Finalize()
+            return total, mx, data, ranks, out.copy()
+
+        res = run_spmd(main, n=4)
+        for total, mx, data, ranks, arr in res:
+            assert total == 10 and mx == 3
+            assert data == {"v": 42} and ranks == [0, 1, 2, 3]
+            np.testing.assert_array_equal(arr, np.full(4, 6.0))
+
+    def test_isend_irecv_wait(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            if r == 0:
+                req = comm.isend([1, 2, 3], dest=1, tag=2)
+                req.wait()
+                out = None
+            else:
+                out = comm.irecv(source=0, tag=2).wait()
+            MPI.Finalize()
+            return out
+
+        assert run_spmd(main, n=2)[1] == [1, 2, 3]
+
+    def test_split_dup_and_group_collectives(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            half = comm.Split(color=r % 2, key=r)
+            peers = half.allgather(r)
+            dup = half.Dup()
+            s = dup.allreduce(1, op=MPI.SUM)
+            dup.Free()
+            half.Free()
+            MPI.Finalize()
+            return peers, s
+
+        res = run_spmd(main, n=4)
+        assert res[0][0] == [0, 2] and res[1][0] == [1, 3]
+        assert all(s == 2 for _, s in res)
+
+    def test_wtime_and_processor_name(self):
+        from mpi_tpu.compat import MPI
+
+        assert MPI.Wtime() <= MPI.Wtime()
+        assert isinstance(MPI.Get_processor_name(), str)
+
+
+@pytest.mark.integration
+class TestDropIn:
+    def test_mpi4py_tutorial_script_runs_unmodified(self, tmp_path):
+        # The canonical mpi4py point-to-point + collective tutorial
+        # shape, verbatim except the import line.
+        script = tmp_path / "tutorial.py"
+        script.write_text(
+            "import sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from mpi_tpu.compat import MPI   # was: from mpi4py import MPI\n"
+            "import numpy as np\n"
+            "comm = MPI.COMM_WORLD\n"
+            "rank = comm.Get_rank()\n"
+            "size = comm.Get_size()\n"
+            "if rank == 0:\n"
+            "    data = {'a': 7, 'b': 3.14}\n"
+            "    comm.send(data, dest=1, tag=11)\n"
+            "elif rank == 1:\n"
+            "    data = comm.recv(source=0, tag=11)\n"
+            "    assert data == {'a': 7, 'b': 3.14}\n"
+            "sendbuf = np.full(4, rank, dtype='d')\n"
+            "recvbuf = np.empty(4, dtype='d')\n"
+            "comm.Allreduce(sendbuf, recvbuf, op=MPI.SUM)\n"
+            "assert (recvbuf == sum(range(size))).all()\n"
+            "total = comm.allreduce(rank, op=MPI.SUM)\n"
+            "print(f'rank {rank}/{size} total {total} OK')\n"
+            "MPI.Finalize()\n" % str(REPO))
+        port = _free_port_block(4)
+        res = subprocess.run(
+            [sys.executable, "-m", "mpi_tpu.launch.mpirun",
+             "--port-base", str(port), "--timeout", "30",
+             "3", str(script)],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert res.returncode == 0, res.stderr[-500:]
+        assert res.stdout.count("OK") == 3
+        assert "total 3" in res.stdout
+
+
+class TestMpi4pySemantics:
+    def test_sendrecv_positional_recvbuf_slot(self):
+        # mpi4py's 4th positional is recvbuf — a drop-in script passing
+        # None there must still receive (the old signature bound it to
+        # source=None and silently skipped the receive leg).
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            peer = 1 - r
+            got = comm.sendrecv(f"m{r}", peer, 11, None, peer)
+            MPI.Finalize()
+            return got
+
+        res = run_spmd(main, n=2)
+        assert res == ["m1", "m0"]
+
+    def test_sendrecv_distinct_tags_and_status(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            peer = 1 - r
+            st = MPI.Status()
+            got = comm.sendrecv(r * 10, peer, sendtag=r, source=peer,
+                                recvtag=peer, status=st)
+            MPI.Finalize()
+            return got, st.Get_source()
+
+        res = run_spmd(main, n=2)
+        assert res[0] == (10, 1) and res[1] == (0, 0)
+
+    def test_any_tag_raises_loudly(self):
+        def main():
+            MPI, comm = _world()
+            try:
+                comm.recv(source=0, tag=MPI.ANY_TAG)
+                out = None
+            except Exception as exc:
+                out = str(exc)
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert all(o and "ANY_TAG" in o for o in res)
+
+    def test_irecv_any_source_fills_status(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            if r == 0:
+                req = comm.irecv(source=MPI.ANY_SOURCE, tag=4)
+                st = MPI.Status()
+                obj = req.wait(st)
+                out = (obj, st.Get_source())
+            else:
+                comm.send("payload", dest=0, tag=4)
+                out = None
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[0] == ("payload", 1)
+
+    def test_probe_any_source_default(self):
+        def main():
+            import time as _t
+
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            if r == 0:
+                st = MPI.Status()
+                comm.probe(status=st)          # mpi4py default args
+                got = comm.recv(source=st.Get_source(), tag=0)
+                out = (got, st.Get_source())
+            else:
+                _t.sleep(0.05)
+                comm.send("found", dest=0)     # default tag 0
+                out = None
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[0] == ("found", 1)
+
+    def test_comm_world_identity_and_equality(self):
+        def main():
+            MPI, comm = _world()
+            a = MPI.COMM_WORLD
+            same = (comm is a, comm == a, comm == comm.Dup())
+            MPI.Finalize()
+            return same
+
+        res = run_spmd(main, n=2)
+        for is_same, eq_world, eq_dup in res:
+            assert is_same and eq_world
+            assert not eq_dup  # a Dup is a different communicator
