@@ -55,7 +55,7 @@ __all__ = ["flash_decode_attention"]
 _MIN_ROWS = 8  # TPU f32 sublane multiple; small GQA groups pad up
 
 
-def _decode_kernel(n_ref, q_ref, k_ref, v_ref, o_ref,
+def _decode_kernel(n_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                    m_scr, l_scr, acc_scr, *, scale: float, block_k: int,
                    t: int):
     ki = pl.program_id(2)
@@ -98,27 +98,42 @@ def _decode_kernel(n_ref, q_ref, k_ref, v_ref, o_ref,
     def _():
         l = jnp.maximum(l_scr[:, 0], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        # log-sum-exp rows: what cache-parallel decode needs to merge
+        # shard partials exactly (parallel/cache_parallel.py). A shard
+        # whose live prefix is empty reports ~-1e30, which the merge
+        # weights to zero.
+        lse_ref[0, 0, 0] = m_scr[:, 0] + jnp.log(l)
 
 
 def _jnp_fallback(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                  n_valid: jax.Array, group: int) -> jax.Array:
-    """Pallas-less equivalent (also the shape-semantics oracle)."""
+                  n_valid: jax.Array, group: int):
+    """Pallas-less equivalent (also the shape-semantics oracle).
+    Returns (out, lse) like the kernel's with_lse mode. For a fully
+    masked row (n_valid < 0, the cache-parallel empty-shard case) the
+    ctx is an artifact of exp(-inf - -inf) but its lse is ~-1e30, so
+    the shard merge weights it to zero — same contract as the kernel's
+    all-blocks-skipped zero output."""
     b, h, hd = q.shape
     kv = k_cache.shape[2]
     qg = q.reshape(b, kv, group, hd)
     scale = 1.0 / math.sqrt(hd)
     logits = jnp.einsum("bKgk,btKk->bKgt", qg, k_cache) * scale
     col = lax.broadcasted_iota(jnp.int32, logits.shape, 3)
-    logits = jnp.where(col <= n_valid, logits, NEG_INF)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    logits = jnp.where(col <= n_valid, logits, NEG_INF).astype(jnp.float32)
+    m = jnp.max(logits, axis=-1)
+    l = jnp.maximum(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1),
+                    1e-30)
+    probs = jnp.exp(logits - m[..., None]) / l[..., None]
     ctx = jnp.einsum("bKgt,btKk->bKgk", probs.astype(q.dtype), v_cache)
-    return ctx.reshape(b, h, hd)
+    lse = (m + jnp.log(l)).reshape(b, h)
+    return ctx.reshape(b, h, hd), lse
 
 
 def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
                            v_cache: jax.Array, n_valid: jax.Array,
                            block_k: int = 512,
-                           interpret: Optional[bool] = None) -> jax.Array:
+                           interpret: Optional[bool] = None,
+                           with_lse: bool = False):
     """Single-position attention against the cache.
 
     ``q``: (b, h, hd) — the one decode position's queries;
@@ -126,7 +141,9 @@ def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
     ``n_valid``: scalar int32, the query's absolute position (it
     attends to cache columns ``0 .. n_valid`` inclusive — its own k/v
     must already be written at column ``n_valid``). Returns (b, h, hd)
-    in the query dtype."""
+    in the query dtype; with ``with_lse=True`` additionally the
+    float32 (b, h) log-sum-exp rows — the sufficient statistic for
+    merging shard partials in cache-parallel decode."""
     b, h, hd = q.shape
     _, t, kv, _ = k_cache.shape
     if h % kv:
@@ -134,8 +151,9 @@ def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
                          f"kv_heads {kv}")
     group = h // kv
     if not _HAVE_PALLAS:
-        return _jnp_fallback(q, k_cache, v_cache,
-                             jnp.asarray(n_valid, jnp.int32), group)
+        out, lse = _jnp_fallback(q, k_cache, v_cache,
+                                 jnp.asarray(n_valid, jnp.int32), group)
+        return (out, lse) if with_lse else out
     rows = max(group, _MIN_ROWS)
     itp = _should_interpret() if interpret is None else interpret
     # A divisor block size (like the flash kernel's _pick_block) keeps
@@ -167,9 +185,19 @@ def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
             pl.BlockSpec((1, bk, 1, hd),
                          lambda bi, kvi, ki: (bi, ki, kvi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, rows, hd),
-                               lambda bi, kvi, ki: (bi, kvi, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, kv, rows, hd), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, rows, hd),
+                         lambda bi, kvi, ki: (bi, kvi, 0, 0)),
+            # lse rows live as (b, kv, 1, rows): the block's trailing
+            # two dims (1, rows) fit Mosaic's tiling rule (same layout
+            # trick as the flash kernel's lse output).
+            pl.BlockSpec((1, 1, 1, rows),
+                         lambda bi, kvi, ki: (bi, kvi, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kv, rows, hd), q.dtype),
+            jax.ShapeDtypeStruct((b, kv, 1, rows), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((rows, 1), jnp.float32),
             pltpu.VMEM((rows, 1), jnp.float32),
@@ -178,4 +206,8 @@ def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
         interpret=itp,
     )(n_arr, qg, k_cache, v_cache)
 
-    return out[:, :, :group].reshape(b, h, hd)
+    out, lse = out
+    res = out[:, :, :group].reshape(b, h, hd)
+    if not with_lse:
+        return res
+    return res, lse[:, :, 0, :group].reshape(b, h)

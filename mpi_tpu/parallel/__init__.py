@@ -29,6 +29,8 @@ from .ring_attention import (
 from .halo import halo_exchange, jacobi_step_1d, jacobi_step_2d
 from .pipeline import pipeline, pipeline_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
+from .cache_parallel import (cache_parallel_decode_attention,
+                             merge_decode_partials)
 from .zero import constrain_opt_state, shard_opt_state, zero1_specs
 
 __all__ = [
@@ -38,6 +40,8 @@ __all__ = [
     "zero1_specs",
     "shard_opt_state",
     "constrain_opt_state",
+    "cache_parallel_decode_attention",
+    "merge_decode_partials",
     "ring_attention",
     "ring_flash_attention",
     "ring_flash_attention_zigzag",
