@@ -43,6 +43,7 @@ def _schedule(seed: int):
             "reduce_scatter", "sendrecv_ring", "barrier", "alltoall",
             "gather_scatter", "group_allreduce", "iallreduce",
             "rma_epoch", "probe_pass", "fetch_ticket",
+            "receive_any_star", "intercomm_xreduce", "pack_ring",
         ])
         ops.append((kind, int(rng.integers(0, 1 << 30)),
                     int(rng.integers(0, N)),
@@ -120,6 +121,44 @@ def _run_schedule(comm, rank: int, seed: int):
                 log.append("sent")
             else:
                 log.append("idle")
+        elif kind == "receive_any_star":
+            # MPI_ANY_SOURCE fan-in: the root takes the others' sends
+            # in ARRIVAL order (nondeterministic), so the log records
+            # the sorted (source, value) set — backend-independent.
+            tag = 300 + step
+            if rank == root:
+                got = sorted(comm.receive_any(tag, timeout=30)
+                             for _ in range(n - 1))
+                log.append([(s, int(v)) for s, v in got])
+            else:
+                comm.send(int(base), root, tag)
+                log.append("sent")
+        elif kind == "intercomm_xreduce":
+            # Build an intercomm between parities, reduce across it,
+            # merge, reduce again — construction, remote addressing and
+            # merge ordering all under the randomized net.
+            from mpi_tpu.intercomm import create_intercomm
+
+            side = rank % 2
+            local = comm.split(color=side, key=rank)
+            inter = create_intercomm(local, 0, comm, 1 - side,
+                                     tag=step % 1024)
+            log.append(int(inter.allreduce(base, op=op)))
+            merged = inter.merge(high=(side == 1))
+            log.append([int(merged.allreduce(base, op=op)),
+                        list(merged.members)])
+            merged.free()
+            inter.free()
+            local.free()
+        elif kind == "pack_ring":
+            # MPI_Pack payloads through the sendrecv ring: codec-level
+            # framing must survive every transport identically.
+            buf = mpi_tpu.pack(int(base), f"s{step}",
+                               np.arange(3, dtype=np.int64) + base)
+            got = comm.sendrecv(mpi_tpu.Raw(buf), dest=(rank + 1) % n,
+                                source=(rank - 1) % n, tag=400 + step)
+            a, b, c = mpi_tpu.unpack(bytes(got))
+            log.append([int(a), b, [int(x) for x in c]])
     win.free()
     return log
 
@@ -149,8 +188,8 @@ def test_backends_agree_on_random_schedule(seed):
         )
 
 
-@pytest.mark.parametrize("seed", [23])
-def test_hybrid_agrees_with_tcp_on_random_schedule(seed):
+@pytest.mark.parametrize("seed", [23, 7])  # seed 7 draws the intercomm
+def test_hybrid_agrees_with_tcp_on_random_schedule(seed):       # + pack kinds
     """The same schedule over the hybrid driver (2 hosts x N/2 local
     ranks): hierarchical engines, cross-host rings and composed tags
     must reproduce the tcp driver's log exactly."""
