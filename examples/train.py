@@ -47,6 +47,9 @@ def main() -> None:
                          "default: synthetic stream")
     ap.add_argument("--zero1", action="store_true",
                     help="shard optimizer state over dp (ZeRO-1)")
+    ap.add_argument("--lora", type=int, default=0, metavar="RANK",
+                    help="freeze the base model and train rank-RANK "
+                         "LoRA adapters instead (adapter-only state)")
     ap.add_argument("--optimizer", default="adamw",
                     choices=["adamw", "adafactor", "sgd"])
     ap.add_argument("--warmup-steps", type=int, default=0,
@@ -93,11 +96,31 @@ def main() -> None:
         last = latest_step(args.checkpoint_dir)
         if last is not None:
             start = last
-    init_state, step = make_train_step(
-        cfg, mesh=mesh, learning_rate=1e-2, grad_accum=args.grad_accum,
-        optimizer=args.optimizer, warmup_steps=args.warmup_steps,
-        total_steps=start + args.steps if args.warmup_steps else None,
-        zero1=args.zero1)
+    lora_base = None
+    if args.lora:
+        # Adapter-only fine-tuning: a frozen (sharded) base + LoRA
+        # deltas trained in its place. The base here is fresh-init for
+        # demo purposes; real use restores it from a checkpoint.
+        unsupported = [n for n, v in (("--grad-accum", args.grad_accum > 1),
+                                      ("--warmup-steps", args.warmup_steps),
+                                      ("--zero1", args.zero1),
+                                      ("--resume", args.resume)) if v]
+        if unsupported:
+            raise SystemExit(
+                f"--lora does not support {', '.join(unsupported)} in "
+                f"this demo (adapter state has its own shape)")
+        from mpi_tpu.models import init_sharded_params, make_lora_train_step
+
+        lora_base = init_sharded_params(jax.random.PRNGKey(0), cfg, mesh)
+        init_state, step = make_lora_train_step(
+            cfg, lora_base, rank=args.lora, mesh=mesh, learning_rate=1e-2,
+            optimizer=args.optimizer)
+    else:
+        init_state, step = make_train_step(
+            cfg, mesh=mesh, learning_rate=1e-2, grad_accum=args.grad_accum,
+            optimizer=args.optimizer, warmup_steps=args.warmup_steps,
+            total_steps=start + args.steps if args.warmup_steps else None,
+            zero1=args.zero1)
     state = init_state(jax.random.PRNGKey(0))
     if start:
         state = restore_checkpoint(args.checkpoint_dir, state)
@@ -146,7 +169,14 @@ def main() -> None:
 
         prompt = ShardedLoader(
             SyntheticLM(cfg.vocab, 1, 8, seed=99)).batch_at(0)
-        toks = generate(state["params"], prompt, cfg,
+        if args.lora:
+            # The adapted model = base + trained deltas, merged once.
+            from mpi_tpu.models import merge_lora
+
+            sample_params = merge_lora(lora_base, state["lora"])
+        else:
+            sample_params = state["params"]
+        toks = generate(sample_params, prompt, cfg,
                         max_new_tokens=args.sample)
         print("sampled:", np.asarray(toks)[0].tolist())
 

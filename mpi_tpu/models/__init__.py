@@ -12,9 +12,11 @@ whose parameters, activations and optimizer states are sharded over a
 from .transformer import (
     TransformerConfig,
     init_params,
+    init_sharded_params,
     forward,
     forward_with_aux,
     param_specs,
+    sane_param_specs,
     sanitize_spec,
     apply_rope,
     make_optimizer,
@@ -25,6 +27,8 @@ from .transformer import (
 from .moe import init_moe_params, moe_ffn, moe_specs
 from .generate import decode_step, generate, prefill
 from .quant import QTensor, dequantize, quantize, quantize_params
+from .lora import (lora_init, make_lora_train_parts, make_lora_train_step,
+                   merge_lora)
 from .speculative import generate_lookahead
 from .pipeline_lm import (
     forward_pipelined,
@@ -40,6 +44,8 @@ __all__ = [
     "quantize_params",
     "dequantize",
     "init_params",
+    "init_sharded_params",
+    "sane_param_specs",
     "forward",
     "forward_with_aux",
     "param_specs",
@@ -56,6 +62,10 @@ __all__ = [
     "decode_step",
     "generate",
     "generate_lookahead",
+    "lora_init",
+    "merge_lora",
+    "make_lora_train_parts",
+    "make_lora_train_step",
     "forward_pipelined",
     "init_pipelined_params",
     "make_pipelined_train_step",

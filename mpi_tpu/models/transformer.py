@@ -455,6 +455,28 @@ def make_optimizer(optimizer: str = "adamw", learning_rate: float = 1e-3,
         f"adamw|adafactor|sgd")
 
 
+def sane_param_specs(cfg: TransformerConfig, params: Any,
+                     mesh: Optional[Mesh]):
+    """:func:`param_specs` restructured to ``params``'s tree with every
+    spec sanitized against ``mesh`` (axes the mesh lacks drop out)."""
+    specs = param_specs(cfg)
+    return jax.tree.unflatten(
+        jax.tree.structure(params),
+        [sanitize_spec(s, mesh) for s in jax.tree.leaves(
+            specs, is_leaf=lambda s: isinstance(s, P))])
+
+
+def init_sharded_params(key: jax.Array, cfg: TransformerConfig,
+                        mesh: Mesh) -> Dict[str, Any]:
+    """Fresh parameters committed to their mesh shardings — params
+    only, no optimizer state (callers that need just a base model, e.g.
+    LoRA fine-tuning, avoid allocating and discarding AdamW moments)."""
+    params = init_params(key, cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, sane_param_specs(cfg, params, mesh))
+
+
 def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                      learning_rate: float = 1e-3, grad_accum: int = 1,
                      optimizer: str = "adamw", warmup_steps: int = 0,
@@ -500,19 +522,11 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                          total_steps)
 
     def _sane_param_specs(params):
-        specs = param_specs(cfg)
-        return jax.tree.unflatten(
-            jax.tree.structure(params),
-            [sanitize_spec(s, mesh) for s in jax.tree.leaves(
-                specs, is_leaf=lambda s: isinstance(s, P))])
+        return sane_param_specs(cfg, params, mesh)
 
     def init_state(key: jax.Array):
-        params = init_params(key, cfg)
         if mesh is not None:
-            sane_specs = _sane_param_specs(params)
-            params = jax.tree.map(
-                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-                params, sane_specs)
+            params = init_sharded_params(key, cfg, mesh)
             opt_state = jax.jit(opt.init)(params)
             if zero1:
                 from ..parallel.zero import shard_opt_state, zero1_specs
@@ -520,6 +534,7 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                 zspecs = zero1_specs(params, sane_specs, opt_state, mesh)
                 opt_state = shard_opt_state(opt_state, zspecs, mesh)
         else:
+            params = init_params(key, cfg)
             opt_state = opt.init(params)
         return {"params": params, "opt": opt_state}
 

@@ -1,0 +1,111 @@
+"""LoRA fine-tuning tests (models/lora.py).
+
+Invariants: zero-init adapters leave the model EXACTLY at the base
+(step-0 identity); training moves only the adapters (base untouched by
+construction — the state carries no base params at all) yet reduces
+the loss; the serving-time merge reproduces the adapted forward; the
+trainable footprint is orders of magnitude below full fine-tuning.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_tpu.models import TransformerConfig, forward, init_params
+from mpi_tpu.models.lora import (count_params, lora_init,
+                                 make_lora_train_step, merge_lora)
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64, max_seq=32)
+
+
+def _tokens(batch=4, seq=17, seed=1):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, CFG.vocab, (batch, seq)),
+        dtype=jnp.int32)
+
+
+class TestInit:
+    def test_zero_init_is_identity(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        lora = lora_init(jax.random.PRNGKey(1), params, rank=4)
+        merged = merge_lora(params, lora)
+        toks = _tokens()[:, :-1]
+        np.testing.assert_array_equal(
+            np.asarray(forward(params, toks, CFG)),
+            np.asarray(forward(merged, toks, CFG)))
+
+    def test_trainable_footprint_is_tiny(self):
+        # Realistic shapes (the toy CFG is too small for the ratio to
+        # mean anything): rank-8 q/v adapters on a d512 model sit under
+        # 1% of the full parameter count.
+        cfg = TransformerConfig(vocab=1024, d_model=512, n_heads=8,
+                                n_layers=2, d_ff=2048, max_seq=32)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        lora = lora_init(jax.random.PRNGKey(1), params, rank=8)
+        assert count_params(lora["blocks"]) < 0.01 * count_params(params)
+
+    def test_bad_targets_and_rank_rejected(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        with pytest.raises(ValueError, match="unknown LoRA targets"):
+            lora_init(jax.random.PRNGKey(1), params, 4, targets=("wz",))
+        with pytest.raises(ValueError, match="rank"):
+            lora_init(jax.random.PRNGKey(1), params, 0)
+
+
+class TestTraining:
+    def test_adapter_only_training_reduces_loss(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        init_state, step = make_lora_train_step(
+            CFG, params, rank=8, learning_rate=5e-2)
+        state = init_state(jax.random.PRNGKey(2))
+        toks = _tokens()
+        losses = []
+        for _ in range(8):
+            state, loss = step(state, toks)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] - 0.05, losses
+        # the state holds adapters only — no base-params copy to drift
+        assert set(state.keys()) == {"lora", "opt"}
+
+    def test_merge_matches_adapted_training_loss(self):
+        from mpi_tpu.models.transformer import loss_fn
+
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        init_state, step = make_lora_train_step(
+            CFG, params, rank=4, alpha=16.0, learning_rate=5e-2)
+        state = init_state(jax.random.PRNGKey(3))
+        toks = _tokens()
+        for _ in range(3):
+            state, loss = step(state, toks)
+        merged = merge_lora(params, state["lora"], alpha=16.0)
+        merged_loss = float(loss_fn(merged, toks, CFG, None))
+        # one more step's reported loss must equal the merged model's
+        # loss on the same batch (the merge IS the adapted model)
+        _, next_loss = step(state, toks)
+        assert merged_loss == pytest.approx(float(next_loss), rel=1e-5)
+
+    def test_sharded_base_with_replicated_adapters(self):
+        from mpi_tpu.models import make_mesh_nd, make_train_step
+
+        mesh = make_mesh_nd(8)
+        init_full, _ = make_train_step(CFG, mesh=mesh)
+        base = init_full(jax.random.PRNGKey(0))["params"]  # tp-sharded
+        init_state, step = make_lora_train_step(
+            CFG, base, rank=4, mesh=mesh, learning_rate=2e-2)
+        state = init_state(jax.random.PRNGKey(4))
+        toks = _tokens()
+        state, l1 = step(state, toks)
+        state, l2 = step(state, toks)
+        assert np.isfinite(float(l1)) and float(l2) < float(l1) + 0.5
+
+    def test_custom_targets_cover_ffn(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        lora = lora_init(jax.random.PRNGKey(1), params, 2,
+                         targets=("w1", "w2", "wo"))
+        entry = lora["blocks"][0]
+        assert set(entry) == {"w1", "w2", "wo"}
+        merged = merge_lora(params, lora)
+        assert merged["blocks"][0]["w1"].shape == \
+            params["blocks"][0]["w1"].shape
