@@ -361,17 +361,124 @@ def measure_allreduce(size_bytes: int = 256 << 20, chain: int = 5) -> dict:
     }
 
 
-def _allreduce_child(size_bytes: int) -> int:
+def _hybrid_allreduce_child() -> int:
+    """Subprocess leg: the TWO-TIER hierarchical allreduce at BASELINE
+    config-5 scale — 4 in-process "hosts" x 8 local ranks = 32 global
+    ranks (local xla leg + loopback-TCP leader leg, the exact engine a
+    multi-host deployment runs). Reports the 1 MiB p50 per-op latency
+    and algorithmic bandwidth as JSON. Numbers measure the engine on
+    one machine (threads + loopback), not a network fabric."""
+    from mpi_tpu.utils.platform import force_platform
+
+    force_platform("cpu", 1)
+    import socket as socketmod
+    import threading
+
+    import numpy as np
+
+    from mpi_tpu.backends.hybrid import HybridNetwork, run_spmd_hybrid
+    from mpi_tpu.backends.tcp import TcpNetwork
+
+    hosts, local = 4, 8
+    size_bytes = 1 << 20
+    reps, warmup = 12, 3
+
+    socks = []
+    for _ in range(hosts):
+        s = socketmod.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    addrs = sorted(f"127.0.0.1:{s.getsockname()[1]:05d}" for s in socks)
+    for s in socks:
+        s.close()
+
+    elems = size_bytes // 4
+    times: list = []
+
+    def fn_for(net):
+        def main():
+            net.init()
+            x = np.full(elems, float(net.rank()), np.float32)
+            for i in range(warmup + reps):
+                t0 = time.perf_counter()
+                r = net.allreduce(x)
+                dt = time.perf_counter() - t0
+                if net.rank() == 0:
+                    if i >= warmup:
+                        times.append(dt)
+                    if i == 0 and not np.allclose(
+                            np.asarray(r)[:4], 31 * 32 / 2):
+                        raise RuntimeError("hybrid allreduce wrong sum")
+            net.finalize()
+        return main
+
+    nets = [HybridNetwork(
+        local_ranks=local,
+        tcp=TcpNetwork(addr=a, addrs=list(addrs), timeout=60.0,
+                       proto="tcp")) for a in addrs]
+    errs: list = []
+
+    def host_main(net):
+        try:
+            run_spmd_hybrid(fn_for(net), net, register_facade=False)
+        except BaseException as exc:  # noqa: BLE001 - join + surface
+            errs.append(exc)
+
+    threads = [threading.Thread(target=host_main, args=(n,), daemon=True)
+               for n in nets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    if errs:
+        raise errs[0]
+    if any(t.is_alive() for t in threads):
+        # A hung host past the join deadline means the world is broken:
+        # an empty `times` would raise a bare StatisticsError and a
+        # partial one would print a normal-looking line measured
+        # against a wedged engine — fail explicitly instead.
+        raise RuntimeError(
+            "hybrid allreduce: host thread(s) still running after 300s")
+    p50 = statistics.median(times)
+    print(json.dumps({
+        "hybrid_allreduce_1MiB_p50_us_4x8": round(p50 * 1e6, 1),
+        "hybrid_allreduce_1MiB_gbps_4x8": round(size_bytes / p50 / 1e9, 3),
+        "hybrid_allreduce_world": hosts * local,
+    }))
+    return 0
+
+
+def measure_hybrid_allreduce() -> dict:
+    """Run the 32-rank two-tier allreduce in a subprocess (it pins the
+    CPU platform and spawns 32 threads) and return its keys."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--_hybrid-allreduce-child"],
+        capture_output=True, text=True, timeout=420)
+    if proc.returncode != 0:
+        raise RuntimeError(f"hybrid allreduce child failed: "
+                           f"{proc.stderr[-500:]}")
+    return json.loads(
+        [l for l in proc.stdout.splitlines() if l.startswith("{")][-1])
+
+
+def _allreduce_child(sizes_csv: str) -> int:
     """Subprocess leg: the same measurement on an 8-device virtual CPU
     mesh — exercises the real multi-device collective path (GSPMD
     all-reduce over 8 shards) when the parent's chip count is 1. CPU
     numbers measure the collective's code path, not ICI — the keys are
-    suffixed accordingly by main()."""
+    suffixed accordingly by main(). ``sizes_csv`` is a comma-separated
+    byte-size list; all sizes' keys merge into one JSON line so the
+    default bench emits the BASELINE config-3 curve, not one point."""
     from mpi_tpu.utils.platform import force_platform
 
     force_platform("cpu", 8)
-    r = measure_allreduce(size_bytes, chain=3)
-    print(json.dumps(r))
+    merged: dict = {}
+    for s in sizes_csv.split(","):
+        merged.update(measure_allreduce(int(s), chain=3))
+    print(json.dumps(merged))
     return 0
 
 
@@ -562,17 +669,19 @@ def bounce_tcp(proto: str = "tcp", port_base: int = 6200) -> float:
 # Entry
 # --------------------------------------------------------------------------
 
-def _allreduce_on_virtual_mesh(size_bytes: int) -> dict:
-    """Run the allreduce measurement in a subprocess pinned to an
-    8-device virtual CPU mesh and return its keys suffixed with
-    ``_cpu8mesh`` — the multi-device collective path, measured even when
-    this process owns a single chip."""
+def _allreduce_on_virtual_mesh(sizes) -> dict:
+    """Run the allreduce measurement (one or many sizes) in a subprocess
+    pinned to an 8-device virtual CPU mesh and return its keys suffixed
+    with ``_cpu8mesh`` — the multi-device collective path, measured even
+    when this process owns a single chip."""
     import subprocess
 
+    if isinstance(sizes, int):
+        sizes = [sizes]
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__),
-         "--_allreduce-child", str(size_bytes)],
-        capture_output=True, text=True, timeout=300)
+         "--_allreduce-child", ",".join(str(s) for s in sizes)],
+        capture_output=True, text=True, timeout=600)
     if proc.returncode != 0:
         raise RuntimeError(f"allreduce child failed: {proc.stderr[-500:]}")
     rec = json.loads(
@@ -642,7 +751,9 @@ def main() -> int:
         return _bounce_device_child(int(sys.argv[idx + 1]))
     if "--_allreduce-child" in sys.argv:
         idx = sys.argv.index("--_allreduce-child")
-        return _allreduce_child(int(sys.argv[idx + 1]))
+        return _allreduce_child(sys.argv[idx + 1])
+    if "--_hybrid-allreduce-child" in sys.argv:
+        return _hybrid_allreduce_child()
     # --platform cpu[:N] pins the JAX platform before any device query;
     # the driver runs with no flag and gets the real chip.
     if "--platform" in sys.argv:
@@ -673,8 +784,38 @@ def main() -> int:
         # hang). On failure, fall back to CPU with explicit provenance
         # so the run still yields a complete, honestly-labelled line.
         # The probe never outlives the overall deadline (line contract).
-        limit = 300.0 if deadline <= 0 else min(300.0, deadline / 2)
-        ok, why = _device_preflight(timeout_s=limit)
+        # Retried: the tunnel is known to drop AND recover, so a single
+        # failed probe must not forfeit the whole round to CPU smoke
+        # numbers (round-2 lesson). Up to 3 probes share a deadline/2
+        # budget.
+        budget = 300.0 if deadline <= 0 else min(300.0, deadline / 2)
+        per_probe = max(30.0, budget / 3)
+        probe_deadline = time.monotonic() + budget
+        ok, why = False, "no probe ran"
+        for attempt in range(3):
+            remaining = probe_deadline - time.monotonic()
+            if remaining <= 1.0:
+                break
+            probe_t0 = time.monotonic()
+            ok, why = _device_preflight(
+                timeout_s=min(per_probe, remaining))
+            if ok:
+                break
+            print(f"bench: accelerator preflight attempt {attempt + 1} "
+                  f"failed ({why[:120]}); "
+                  + ("retrying" if attempt < 2 else "giving up"),
+                  file=sys.stderr)
+            if attempt < 2:
+                # An instant failure (UNAVAILABLE at backend init) would
+                # otherwise burn all three probes within seconds; space
+                # the attempts out so a drop-AND-recover tunnel gets a
+                # real second chance inside the budget.
+                spent = time.monotonic() - probe_t0
+                pause = min(max(0.0, per_probe - spent),
+                            max(0.0, probe_deadline - time.monotonic()
+                                - per_probe))
+                if pause > 0:
+                    time.sleep(pause)
         if not ok:
             from mpi_tpu.utils.platform import force_platform
 
@@ -765,16 +906,34 @@ def main() -> int:
         _leg("decode", measure_decode)
         _leg("decode_int8", lambda: measure_decode(int8=True))
 
+    # BASELINE config-3 compact curve, in the DEFAULT line (the driver
+    # never passes --suite): 1 KiB -> 256 MiB in x32 steps. On real
+    # multi-chip hardware the curve comes from measure_allreduce per
+    # size; on the 1-chip/CPU box it runs on the virtual 8-device mesh.
+    # Smoke/fallback runs cap at 1 MiB: the big points on a single-core
+    # CPU cost minutes each, exactly what the smoke degradation is
+    # protecting the watchdog deadline from.
+    curve_sizes = [1 << 10, 32 << 10, 1 << 20]
+    if not smoke:
+        curve_sizes += [32 << 20, 256 << 20]
+
     def allreduce_legs():
         ar = measure_allreduce(ar_size)
         if ar.get("allreduce_devices") == 1:
             # Single chip: the in-process collective is the identity
             # (keys are null); measure the real multi-device path on a
-            # virtual 8-device mesh instead.
-            ar.update(_allreduce_on_virtual_mesh(ar_size))
+            # virtual 8-device mesh instead — the full compact curve.
+            ar.update(_allreduce_on_virtual_mesh(curve_sizes))
+        else:
+            for s in curve_sizes:
+                if s != ar_size:
+                    ar.update(measure_allreduce(s))
         return ar
 
     _leg("allreduce", allreduce_legs)
+    # BASELINE config 5: the hierarchical two-tier engine at 32 ranks
+    # (4 hosts x 8 locals), in the default line.
+    _leg("hybrid_allreduce", measure_hybrid_allreduce)
     if "--suite" in sys.argv:
         _leg("sweep", lambda: allreduce_sweep() or {})
 

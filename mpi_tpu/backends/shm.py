@@ -407,13 +407,21 @@ class ShmConn:
             lib = _native.shmcore()
             buf = bytes(payload) if not isinstance(payload, bytes) else payload
             deadline = self._deadline()
-            while True:
-                rc = lib.shm_send_frame(tx._h, kind, tag, buf, len(buf),
-                                        self._remaining_ms(deadline, "send"))
-                if rc != -_errno.EINTR:
-                    break
-                # returning to the interpreter here runs pending Python
-                # signal handlers (Ctrl+C), then the op resumes
+            try:
+                while True:
+                    rc = lib.shm_send_frame(
+                        tx._h, kind, tag, buf, len(buf),
+                        self._remaining_ms(deadline, "send"))
+                    if rc != -_errno.EINTR:
+                        break
+                    # returning to the interpreter here runs pending
+                    # Python signal handlers (Ctrl+C); the op resumes
+            except socket.timeout:
+                # Python-side deadline expiry between -EINTR resumes
+                # abandons the op exactly like a native -ETIMEDOUT
+                # would: poison if that strands the stream mid-frame.
+                lib.shm_abandon(tx._h, 0)
+                raise
             if rc == _native.PEER_CLOSED:
                 raise ConnectionError("shm ring closed by peer")
             if rc == -_errno.ETIMEDOUT:
@@ -435,24 +443,34 @@ class ShmConn:
             tag = ctypes.c_int64()
             length = ctypes.c_uint32()
             deadline = self._deadline()
-            while True:
-                rc = lib.shm_recv_hdr(rx._h, ctypes.byref(kind),
-                                      ctypes.byref(tag), ctypes.byref(length),
-                                      self._remaining_ms(deadline,
-                                                         "recv header"))
-                if rc != -_errno.EINTR:
-                    break
+            try:
+                while True:
+                    rc = lib.shm_recv_hdr(
+                        rx._h, ctypes.byref(kind), ctypes.byref(tag),
+                        ctypes.byref(length),
+                        self._remaining_ms(deadline, "recv header"))
+                    if rc != -_errno.EINTR:
+                        break
+            except socket.timeout:
+                lib.shm_abandon(rx._h, 0)  # poison only if mid-header
+                raise
             self._check_rc(rc, "recv header")
             n = length.value
             payload = bytearray(n)
             if n:
                 arr = (ctypes.c_ubyte * n).from_buffer(payload)
-                while True:
-                    rc = lib.shm_recv_payload(
-                        rx._h, arr, n,
-                        self._remaining_ms(deadline, "recv payload"))
-                    if rc != -_errno.EINTR:
-                        break
+                try:
+                    while True:
+                        rc = lib.shm_recv_payload(
+                            rx._h, arr, n,
+                            self._remaining_ms(deadline, "recv payload"))
+                        if rc != -_errno.EINTR:
+                            break
+                except socket.timeout:
+                    # mid-frame by definition: the header announcing
+                    # this payload was already consumed (force=1).
+                    lib.shm_abandon(rx._h, 1)
+                    raise
                 self._check_rc(rc, "recv payload")
             return kind.value, tag.value, payload
         deadline = None if self._timeout is None \

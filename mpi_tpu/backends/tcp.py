@@ -74,12 +74,13 @@ _FRAME_HDR = struct.Struct("<BqI")
 _DIAL_RETRY_INTERVAL = 0.1  # network.go:298 — 100 ms poll
 
 # The reference's NetProto accepts any `net` package protocol
-# (network.go:26). Supported here: TCP (the default, "tcp4" an alias),
+# (network.go:26). Supported here: TCP (the default, "tcp4" an alias,
+# "tcp6" for IPv6 with Go's "[::1]:5000" bracket addresses),
 # unix-domain stream sockets (addresses = filesystem paths), and "shm"
 # — same-host shared-memory rings via the native engine
 # (backends/shm.py, native/shmcore.cpp; addresses = opaque ids).
 # Anything else raises at init instead of being silently ignored.
-_SUPPORTED_PROTOS = ("tcp", "tcp4", "unix", "shm")
+_SUPPORTED_PROTOS = ("tcp", "tcp4", "tcp6", "unix", "shm")
 
 
 class InitError(MpiError):
@@ -91,6 +92,10 @@ def _split_hostport(addr: str) -> Tuple[str, int]:
     host, sep, port = addr.rpartition(":")
     if not sep:
         raise MpiError(f"mpi_tpu: address {addr!r} missing :port")
+    # Go's net.SplitHostPort bracket syntax for IPv6 literals:
+    # "[::1]:5000" -> host "::1".
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
     return host, int(port)
 
 
@@ -369,7 +374,7 @@ class TcpNetwork:
 
     def _tune(self, sock: socket.socket) -> None:
         """Latency tuning where applicable (TCP only)."""
-        if self.proto in ("tcp", "tcp4"):
+        if self.proto in ("tcp", "tcp4", "tcp6"):
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def _use_flags(self) -> None:
@@ -476,7 +481,9 @@ class TcpNetwork:
                 ) from exc
         else:
             host, port = _split_hostport(self.addr)
-            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            family = (socket.AF_INET6 if self.proto == "tcp6"
+                      else socket.AF_INET)
+            listener = socket.socket(family, socket.SOCK_STREAM)
             listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             try:
                 listener.bind((host, port))
@@ -547,8 +554,10 @@ class TcpNetwork:
                         sock.settimeout(self.timeout)
                         sock.connect(target)
                     else:
+                        default_host = ("::1" if self.proto == "tcp6"
+                                        else "localhost")
                         sock = socket.create_connection(
-                            (target_host or "localhost", target_port),
+                            (target_host or default_host, target_port),
                             timeout=self.timeout)
                     break
                 except OSError as exc:

@@ -17,10 +17,20 @@ mpi4py user expects — lowered onto whichever driver is active (tcp,
 xla, hybrid), so "mpi4py code" transparently runs its collectives as
 compiled XLA programs on TPU.
 
+One-sided RMA (``MPI.Win.Create`` + ``Put``/``Get``/``Accumulate``/
+``Get_accumulate``/``Fetch_and_op``/``Fence``), parallel IO
+(``MPI.File.Open`` + ``Read_at``/``Write_at``/collective ``_all``
+variants/``Set_view``), and Cartesian topologies
+(``comm.Create_cart`` + ``Get_coords``/``Shift``/``Sub``) are wrapped
+over the native :mod:`mpi_tpu.window`, :mod:`mpi_tpu.io`, and
+:class:`mpi_tpu.comm.CartComm` subsystems.
+
 Scope honesty: this is the commonly-used core surface, not all of
 mpi4py (no derived datatypes beyond numpy dtypes, no dynamic process
-management, no passive-target RMA — the native API has the supported
-RMA surface in :mod:`mpi_tpu.window`). ``COMM_WORLD`` auto-initializes
+management, no passive-target RMA — windows are active-target
+fence-synchronized; window displacements are element offsets into the
+exposed array, so ``disp_unit`` is accepted only at its dtype-itemsize
+value). ``COMM_WORLD`` auto-initializes
 the framework on first use, matching mpi4py's import-time init
 ergonomics; call ``MPI.Finalize()`` (or ``mpi_tpu.finalize()``) at the
 end as usual. No reference analogue (pure framework-usability work).
@@ -226,11 +236,12 @@ class Comm:
     def Recv(self, buf: Any, source: int = -1, tag: int = 0,
              status: Optional[Status] = None) -> None:
         _check_tag_not_wild(tag, "Recv")
+        out = _writable_buffer(buf, "Recv")
         if source == ANY_SOURCE:
             src, got = self._c.receive_any(tag)
         else:
             src, got = source, self._c.receive(source, tag)
-        np.copyto(np.asarray(buf), got)
+        np.copyto(out, np.asarray(got).reshape(out.shape))
         if status is not None:
             status.source, status.tag = src, tag
 
@@ -245,19 +256,21 @@ class Comm:
         return self._c.bcast(obj, root=root)
 
     def Bcast(self, buf: Any, root: int = 0) -> None:
+        out = _writable_buffer(buf, "Bcast")
         got = self._c.bcast(
-            np.ascontiguousarray(buf) if self.Get_rank() == root else None,
+            np.ascontiguousarray(out) if self.Get_rank() == root else None,
             root=root)
-        np.copyto(np.asarray(buf), got)
+        np.copyto(out, np.asarray(got).reshape(out.shape))
 
     def allreduce(self, sendobj: Any, op: "Op" = None) -> Any:
         return self._c.allreduce(sendobj, op=_op(op))
 
     def Allreduce(self, sendbuf: Any, recvbuf: Any,
                   op: "Op" = None) -> None:
+        out = _writable_buffer(recvbuf, "Allreduce")
         got = self._c.allreduce(np.ascontiguousarray(sendbuf),
                                 op=_op(op))
-        np.copyto(np.asarray(recvbuf), got)
+        np.copyto(out, np.asarray(got).reshape(out.shape))
 
     def reduce(self, sendobj: Any, op: "Op" = None,
                root: int = 0) -> Optional[Any]:
@@ -298,6 +311,282 @@ class Comm:
     def Abort(self, errorcode: int = 1) -> None:
         api.abort(errorcode)
 
+    # -- topology -----------------------------------------------------------
+
+    def Create_cart(self, dims, periods=None,
+                    reorder: bool = False) -> "Cartcomm":
+        """Cartesian communicator over this comm's ranks
+        (``MPI_Cart_create``). ``reorder`` is accepted and ignored —
+        rank order is always preserved, matching the native
+        :func:`mpi_tpu.comm.cart_create` (reorder=false) semantics."""
+        from .comm import cart_create
+
+        return Cartcomm(cart_create(self._c, dims, periods))
+
+
+class Cartcomm(Comm):
+    """mpi4py ``MPI.Cartcomm`` over :class:`mpi_tpu.comm.CartComm`."""
+
+    def __init__(self, native):
+        super().__init__(native)
+
+    # mpi4py properties
+    @property
+    def dims(self) -> List[int]:
+        return list(self._c.dims)
+
+    @property
+    def periods(self) -> List[int]:
+        return [int(p) for p in self._c.periods]
+
+    @property
+    def coords(self) -> List[int]:
+        return list(self._c.coords())
+
+    @property
+    def ndim(self) -> int:
+        return len(self._c.dims)
+
+    @property
+    def topo(self):
+        return self.Get_topo()
+
+    def Get_topo(self):
+        return (self.dims, self.periods, self.coords)
+
+    def Get_cart_rank(self, coords) -> int:
+        return self._c.rank_of(coords)
+
+    def Get_coords(self, rank: int) -> List[int]:
+        return list(self._c.coords(rank))
+
+    def Shift(self, direction: int, disp: int = 1):
+        """(source, dest) for a ``disp`` displacement along axis
+        ``direction`` (``MPI_Cart_shift``); ``MPI.PROC_NULL`` marks the
+        edge of a non-periodic axis."""
+        src, dst = self._c.shift(direction, disp)
+        return (PROC_NULL if src is None else src,
+                PROC_NULL if dst is None else dst)
+
+    def Sub(self, remain_dims) -> "Cartcomm":
+        return Cartcomm(self._c.sub(remain_dims))
+
+
+class Win:
+    """mpi4py ``MPI.Win`` over :class:`mpi_tpu.window.Window` —
+    active-target fence synchronization (``MPI_Win_fence`` epochs).
+
+    Target displacements are ELEMENT offsets into the exposed array
+    (the window's dtype defines the element); ``disp_unit`` is checked
+    against that dtype's itemsize rather than reinterpreted. ``Get``
+    and the fetching accumulates land in the caller's buffer at the
+    closing :meth:`Fence`, per the MPI completion rules."""
+
+    def __init__(self, native):
+        self._w = native
+        # (handle, destination buffer): resolved at the closing fence.
+        self._pending: List[Any] = []
+
+    @classmethod
+    def Create(cls, memory: Any, disp_unit: int = 1, info: Any = None,
+               comm: Optional[Comm] = None) -> "Win":
+        """Collective window creation (``MPI_Win_create``). ``memory``
+        is this rank's exposed 1-D numpy array; ``comm`` defaults to
+        ``COMM_WORLD`` (there is no COMM_SELF here)."""
+        from .window import win_create
+
+        # np.asarray on a list would expose a detached COPY: remote
+        # puts would land where the caller can never see them.
+        mem = _writable_buffer(memory, "Win.Create")
+        if disp_unit not in (1, mem.dtype.itemsize):
+            raise api.MpiError(
+                f"mpi_tpu.compat: Win displacements are element offsets "
+                f"of dtype {mem.dtype}; disp_unit={disp_unit} conflicts "
+                f"with itemsize {mem.dtype.itemsize}")
+        c = (MPI.COMM_WORLD if comm is None else comm)._c
+        return cls(win_create(c, mem))
+
+    @property
+    def native(self):
+        """The underlying :class:`mpi_tpu.window.Window`."""
+        return self._w
+
+    def tomemory(self) -> np.ndarray:
+        """This rank's exposed window memory (local access is legal
+        between fences)."""
+        return self._w.local
+
+    @staticmethod
+    def _disp(target, origin_size: int) -> int:
+        # mpi4py spells the target as disp or [disp, count, datatype].
+        # A count that disagrees with the origin buffer would silently
+        # transfer the wrong span — fail loudly instead (this shim
+        # always moves exactly the origin's elements).
+        if target is None:
+            return 0
+        if isinstance(target, (list, tuple)):
+            if len(target) >= 2 and int(target[1]) != origin_size:
+                raise api.MpiError(
+                    f"mpi_tpu.compat: target spec count {target[1]} != "
+                    f"origin buffer size {origin_size}; this shim "
+                    f"transfers exactly the origin's elements")
+            return int(target[0]) if target else 0
+        return int(target)
+
+    def Put(self, origin: Any, target_rank: int, target=None) -> None:
+        arr = np.asarray(origin)
+        self._w.put(arr, target_rank, self._disp(target, arr.size))
+
+    def Get(self, origin: Any, target_rank: int, target=None) -> None:
+        out = _writable_buffer(origin, "Win.Get")
+        h = self._w.get(target_rank, self._disp(target, out.size),
+                        count=out.size)
+        self._pending.append((h, out))
+
+    def Accumulate(self, origin: Any, target_rank: int, target=None,
+                   op: Optional[Op] = None) -> None:
+        arr = np.asarray(origin)
+        self._w.accumulate(arr, target_rank, self._disp(target, arr.size),
+                           op=_op(op))
+
+    def Get_accumulate(self, origin: Any, result: Any, target_rank: int,
+                       target=None, op: Optional[Op] = None) -> None:
+        out = _writable_buffer(result, "Win.Get_accumulate")
+        arr = np.asarray(origin)
+        h = self._w.get_accumulate(arr, target_rank,
+                                   self._disp(target, arr.size),
+                                   op=_op(op))
+        self._pending.append((h, out))
+
+    def Fetch_and_op(self, origin: Any, result: Any, target_rank: int,
+                     target=0, op: Optional[Op] = None) -> None:
+        out = _writable_buffer(result, "Win.Fetch_and_op")
+        h = self._w.fetch_and_op(np.asarray(origin), target_rank,
+                                 self._disp(target, 1), op=_op(op))
+        self._pending.append((h, out))
+
+    def Fence(self, assertion: int = 0) -> None:
+        """Close the epoch (collective): all queued RMA completes, and
+        every pending ``Get``/``Get_accumulate``/``Fetch_and_op``
+        result is copied into its caller-supplied buffer."""
+        self._w.fence()
+        pending, self._pending = self._pending, []
+        for handle, out in pending:
+            np.copyto(out, handle.array.reshape(out.shape))
+
+    def Shared_query(self, rank: int):
+        """(buffer, disp_unit) — a direct reference to ``rank``'s
+        window memory when the driver shares one address space
+        (``MPI_Win_shared_query``); raises otherwise."""
+        arr = self._w.shared_query(rank)
+        return arr, arr.dtype.itemsize
+
+    def Free(self) -> None:
+        if self._pending:
+            raise api.MpiError(
+                "mpi_tpu.compat: Win.Free() with un-fenced Get pending")
+        self._w.free()
+
+
+class File:
+    """mpi4py ``MPI.File`` over :mod:`mpi_tpu.io` — open with
+    :meth:`Open`; byte offsets follow the default byte-etype view, so
+    ``Read_at(offset, buf)``/``Write_at`` address absolute file bytes
+    exactly as mpi4py's default view does."""
+
+    def __init__(self, native):
+        self._f = native
+
+    @classmethod
+    def Open(cls, comm: Comm, filename: str, amode: Optional[int] = None,
+             info: Any = None) -> "File":
+        """Collective open (``MPI_File_open``). ``amode`` combines the
+        ``MPI.MODE_*`` bits; RDONLY opens existing read-only, CREATE
+        opens read-write creating if missing (MPI semantics: open never
+        truncates), plain RDWR/WRONLY requires the file to exist."""
+        from .io import open_file
+
+        if amode is None:
+            amode = MODE_RDONLY
+        if amode & MODE_RDONLY:
+            mode = "r"
+        elif amode & MODE_CREATE:
+            mode = "a"
+        else:
+            # RDWR/WRONLY on an existing file: "a" never truncates, and
+            # existence is enforced here (native "a" would create).
+            import os as _os
+
+            if not _os.path.exists(filename):
+                raise api.MpiError(
+                    f"mpi_tpu.compat: File.Open({filename!r}) without "
+                    f"MPI.MODE_CREATE: file does not exist")
+            mode = "a"
+        return cls(open_file(comm._c, filename, mode))
+
+    @property
+    def native(self):
+        """The underlying :class:`mpi_tpu.io.File`."""
+        return self._f
+
+    def Get_size(self) -> int:
+        return self._f.size()
+
+    def Set_size(self, size: int) -> None:
+        self._f.set_size(size)
+
+    def Read_at(self, offset: int, buf: Any, status: Any = None) -> None:
+        out = _writable_buffer(buf, "File.Read_at")
+        got = self._f.read_at(int(offset), out.size, out.dtype)
+        np.copyto(out, got.reshape(out.shape))
+
+    def Write_at(self, offset: int, buf: Any) -> None:
+        self._f.write_at(int(offset), np.ascontiguousarray(buf))
+
+    def Read_at_all(self, offset: int, buf: Any,
+                    status: Any = None) -> None:
+        out = _writable_buffer(buf, "File.Read_at_all")
+        got = self._f.read_at_all(int(offset), out.size, out.dtype)
+        np.copyto(out, got.reshape(out.shape))
+
+    def Write_at_all(self, offset: int, buf: Any) -> None:
+        self._f.write_at_all(int(offset), np.ascontiguousarray(buf))
+
+    def Set_view(self, disp: int = 0, etype: Any = np.uint8,
+                 block: int = 1, stride: Optional[int] = None,
+                 index: Optional[int] = None) -> None:
+        """Install this rank's strided view. mpi4py spells the filetype
+        as a derived datatype; here the ``MPI_Type_vector`` parameters
+        are passed directly (``block`` elements of ``etype`` per round
+        of ``stride``, this rank at round offset ``index * block`` —
+        defaults give the row-cyclic rank split)."""
+        self._f.set_view(disp, etype, block=block, stride=stride,
+                         index=index)
+
+    def Read_all(self, buf: Any, status: Any = None) -> None:
+        out = _writable_buffer(buf, "File.Read_all")
+        got = self._f.read_all(out.size)
+        np.copyto(out, got.astype(out.dtype, copy=False)
+                  .reshape(out.shape))
+
+    def Write_all(self, buf: Any) -> None:
+        self._f.write_all(np.ascontiguousarray(buf))
+
+    def Write_ordered(self, buf: Any, offset: int = 0) -> int:
+        return self._f.write_ordered(np.ascontiguousarray(buf), offset)
+
+    def Sync(self) -> None:
+        self._f.sync()
+
+    def Close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "File":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.Close()
+
 
 class Op:
     """Reduction-op constant (SUM/PROD/MIN/MAX)."""
@@ -319,6 +608,40 @@ def _op(op: Optional[Op]) -> Any:
 
 ANY_SOURCE = -1
 ANY_TAG = -2
+# PROC_NULL marks the edge of a non-periodic Cartesian axis; -3 avoids
+# this shim's ANY_SOURCE/ANY_TAG values (implementations differ on the
+# exact integers; mpi4py code compares against the constant, not -1).
+PROC_NULL = -3
+
+# MPI_File amode bits (the ROMIO/MPICH values — mpi4py exposes the same
+# names; code combines them with |).
+MODE_CREATE = 1
+MODE_RDONLY = 2
+MODE_WRONLY = 4
+MODE_RDWR = 8
+MODE_DELETE_ON_CLOSE = 16
+MODE_UNIQUE_OPEN = 32
+MODE_EXCL = 64
+MODE_APPEND = 128
+MODE_SEQUENTIAL = 256
+
+
+def _writable_buffer(buf: Any, what: str) -> np.ndarray:
+    """The caller's receive buffer, as the ndarray written THROUGH
+    (mpi4py buffer semantics). A non-ndarray (e.g. a list) would make
+    ``np.asarray`` a temporary and the received data silently vanish;
+    so would copying into a flattened COPY of a non-contiguous view —
+    reject the former loudly, and let callers ``np.copyto`` into the
+    original array (which handles strided views correctly)."""
+    if not isinstance(buf, np.ndarray):
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what} needs a writable numpy array as "
+            f"its receive buffer (got {type(buf).__name__}); a "
+            f"{type(buf).__name__} cannot be written through")
+    if not buf.flags.writeable:
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what} receive buffer is read-only")
+    return buf
 
 
 def _check_tag_not_wild(tag: int, what: str) -> None:
@@ -335,6 +658,16 @@ class _MPI:
 
     ANY_SOURCE = ANY_SOURCE
     ANY_TAG = ANY_TAG
+    PROC_NULL = PROC_NULL
+    MODE_CREATE = MODE_CREATE
+    MODE_RDONLY = MODE_RDONLY
+    MODE_WRONLY = MODE_WRONLY
+    MODE_RDWR = MODE_RDWR
+    MODE_DELETE_ON_CLOSE = MODE_DELETE_ON_CLOSE
+    MODE_UNIQUE_OPEN = MODE_UNIQUE_OPEN
+    MODE_EXCL = MODE_EXCL
+    MODE_APPEND = MODE_APPEND
+    MODE_SEQUENTIAL = MODE_SEQUENTIAL
     SUM = Op("sum")
     PROD = Op("prod")
     MIN = Op("min")
@@ -342,6 +675,9 @@ class _MPI:
     Status = Status
     Request = Request
     Comm = Comm
+    Cartcomm = Cartcomm
+    Win = Win
+    File = File
 
     _world_cache: Optional[Comm] = None
 

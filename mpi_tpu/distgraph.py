@@ -75,24 +75,26 @@ def dist_graph_create_adjacent(comm: Comm, sources: Sequence[int],
     child = comm.split(color=0, key=comm.rank())
     assert child is not None
     if local_err is not None:
-        # Zero the (possibly partially accumulated) counts so peers do
-        # not derive phantom mismatches from an erring rank — its real
-        # error travels in the unconditional exchange below.
-        out_counts = [0] * n
-        in_counts = [0] * n
+        # An erring rank advertises SENTINEL counts (-1): peers then
+        # skip mismatch derivation against it entirely, so a compliant
+        # rank that legitimately declared k edges to the erring rank is
+        # not blamed with a phantom "declares 0 edges" mismatch — the
+        # erring rank's real error travels in the unconditional
+        # exchange below and is the only thing reported against it.
+        out_counts = [-1] * n
     errors = [] if local_err is None else [local_err]
     if validate:
         # Edge-count handshake: what I claim to send to each rank must
         # equal what they claim to receive from me, and vice versa.
-        # A rank with a local error contributes zeroed counts; its real
-        # error travels in the unconditional exchange below.
+        # A count of -1 means "that rank erred locally" — no mismatch
+        # is derived from it (see sentinel note above).
         their_out_to_me = child.alltoall(list(out_counts))
         if local_err is None:
             errors += [
                 f"rank {src}->me declares {cnt} edges, I list "
                 f"{in_counts[src]}"
                 for src, cnt in enumerate(their_out_to_me)
-                if cnt != in_counts[src]]
+                if cnt >= 0 and cnt != in_counts[src]]
     # The error exchange is UNCONDITIONAL (validate=False skips only the
     # count handshake): every rank participates in the same collectives
     # whether or not it erred locally, so bad arguments raise everywhere

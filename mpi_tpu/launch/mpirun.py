@@ -72,6 +72,16 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
                           timeout=timeout, password=password)
     procs: List[subprocess.Popen] = []
     child_env = dict(os.environ if env is None else env)
+    # Children run with the PROGRAM's cwd on their sys.path, not this
+    # launcher's — a user program outside the framework's checkout
+    # would fail its `import mpi_tpu`. Prepend the package root so the
+    # spawned ranks resolve the same framework that launched them.
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    existing = child_env.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        child_env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
+                                   if existing else pkg_root)
     for i, cmd in enumerate(cmds):
         # stdio passthrough, as gompirun pipes child output (gompirun.go:86-88)
         procs.append(subprocess.Popen(cmd, env=child_env))

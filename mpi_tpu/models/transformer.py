@@ -531,7 +531,8 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
             if zero1:
                 from ..parallel.zero import shard_opt_state, zero1_specs
 
-                zspecs = zero1_specs(params, sane_specs, opt_state, mesh)
+                zspecs = zero1_specs(params, _sane_param_specs(params),
+                                     opt_state, mesh)
                 opt_state = shard_opt_state(opt_state, zspecs, mesh)
         else:
             params = init_params(key, cfg)

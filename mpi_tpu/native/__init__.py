@@ -93,6 +93,8 @@ def _configure_shmcore(lib: ctypes.CDLL) -> None:
     lib.shm_recv_payload.restype = ctypes.c_int
     lib.shm_recv_payload.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int]
+    lib.shm_abandon.restype = ctypes.c_int
+    lib.shm_abandon.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.shm_version.restype = ctypes.c_int
     if lib.shm_version() != 1:
         raise RuntimeError("shmcore version mismatch")

@@ -89,6 +89,12 @@ struct Handle {
   // Resumable per-frame progress (one in-flight op per handle: a ring is
   // used in exactly one direction by exactly one thread at a time).
   uint64_t op_done;
+  // Latched when a timeout abandons an op MID-FRAME: the stream position
+  // is then inside a half-written/half-read frame, so any further op on
+  // this handle would silently corrupt framing — every later call fails
+  // with -EPIPE until the ring is closed. (-EINTR resumption with
+  // identical arguments stays legal: it does not latch.)
+  bool poisoned;
   uint8_t frame_hdr[kFrameHdrLen];
 };
 
@@ -244,6 +250,7 @@ Handle *map_handle(int fd, uint64_t map_len) {
   h->map_len = map_len;
   h->fd = fd;
   h->op_done = 0;
+  h->poisoned = false;
   return h;
 }
 
@@ -344,11 +351,20 @@ void shm_ring_close(void *handle) {
   delete h;
 }
 
+// A -ETIMEDOUT that strands the stream inside a frame latches the
+// poison flag (see Handle::poisoned); a timeout at a frame boundary
+// leaves the handle clean and retryable.
+static int poison_if_midframe(Handle *h, int rc) {
+  if (rc == -ETIMEDOUT && h->op_done != 0) h->poisoned = true;
+  return rc;
+}
+
 // Send one frame (header + payload). Resumes after -EINTR when called
 // again with identical arguments; progress lives in the handle.
 int shm_send_frame(void *handle, uint8_t kind, int64_t tag,
                    const uint8_t *payload, uint32_t length, int timeout_ms) {
   Handle *h = static_cast<Handle *>(handle);
+  if (h->poisoned) return -EPIPE;
   if (h->op_done == 0) {
     h->frame_hdr[0] = kind;
     std::memcpy(h->frame_hdr + 1, &tag, 8);
@@ -357,12 +373,12 @@ int shm_send_frame(void *handle, uint8_t kind, int64_t tag,
   if (h->op_done < kFrameHdrLen) {
     int rc = ring_write(h, h->frame_hdr, kFrameHdrLen, timeout_ms,
                         &h->op_done);
-    if (rc != 0) return rc;
+    if (rc != 0) return poison_if_midframe(h, rc);
   }
   uint64_t payload_done = h->op_done - kFrameHdrLen;
   int rc = ring_write(h, payload, length, timeout_ms, &payload_done);
   h->op_done = kFrameHdrLen + payload_done;
-  if (rc != 0) return rc;
+  if (rc != 0) return poison_if_midframe(h, rc);
   h->op_done = 0;
   return 0;
 }
@@ -373,8 +389,9 @@ int shm_send_frame(void *handle, uint8_t kind, int64_t tag,
 int shm_recv_hdr(void *handle, uint8_t *kind, int64_t *tag, uint32_t *length,
                  int timeout_ms) {
   Handle *h = static_cast<Handle *>(handle);
+  if (h->poisoned) return -EPIPE;
   int rc = ring_read(h, h->frame_hdr, kFrameHdrLen, timeout_ms, &h->op_done);
-  if (rc != 0) return rc;
+  if (rc != 0) return poison_if_midframe(h, rc);
   h->op_done = 0;
   *kind = h->frame_hdr[0];
   std::memcpy(tag, h->frame_hdr + 1, 8);
@@ -386,10 +403,25 @@ int shm_recv_hdr(void *handle, uint8_t *kind, int64_t *tag, uint32_t *length,
 int shm_recv_payload(void *handle, uint8_t *buf, uint32_t length,
                      int timeout_ms) {
   Handle *h = static_cast<Handle *>(handle);
+  if (h->poisoned) return -EPIPE;
+  // A timeout here is mid-frame BY DEFINITION (the header announcing
+  // this payload was already consumed), even at op_done == 0.
   int rc = ring_read(h, buf, length, timeout_ms, &h->op_done);
+  if (rc == -ETIMEDOUT) { h->poisoned = true; return rc; }
   if (rc != 0) return rc;
   h->op_done = 0;
   return 0;
+}
+
+// The Python side abandons an in-flight op when ITS deadline expires
+// between -EINTR resumes (the native call itself returned resumable).
+// Latch poison if that strands the stream mid-frame; `force` covers
+// ops that are mid-frame even at op_done == 0 (a payload read whose
+// header was already consumed). Returns 1 if the handle is poisoned.
+int shm_abandon(void *handle, int force) {
+  Handle *h = static_cast<Handle *>(handle);
+  if (force || h->op_done != 0) h->poisoned = true;
+  return h->poisoned ? 1 : 0;
 }
 
 int shm_version() { return 1; }

@@ -278,3 +278,234 @@ class TestMpi4pySemantics:
         for is_same, eq_world, eq_dup in res:
             assert is_same and eq_world
             assert not eq_dup  # a Dup is a different communicator
+
+
+class TestWin:
+    """RMA through the mpi4py spelling (MPI.Win over window.py)."""
+
+    def test_create_put_fence(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            local = np.zeros(n, dtype=np.float64)
+            win = MPI.Win.Create(local, comm=comm)
+            # Everyone writes (rank+1) into slot `r` of every peer.
+            for t in range(n):
+                win.Put(np.array([r + 1.0]), t, target=r)
+            win.Fence()
+            out = local.copy()
+            win.Free()
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=4)
+        for got in res:
+            np.testing.assert_array_equal(got, [1.0, 2.0, 3.0, 4.0])
+
+    def test_get_lands_in_origin_buffer_at_fence(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            local = np.full(3, float(r), dtype=np.float64)
+            win = MPI.Win.Create(local, comm=comm)
+            buf = np.empty(3, dtype=np.float64)
+            win.Get(buf, (r + 1) % n)
+            # Before the fence the buffer is undefined; after it, the
+            # peer's window contents (MPI completion semantics).
+            win.Fence()
+            win.Free()
+            MPI.Finalize()
+            return buf
+
+        res = run_spmd(main, n=3)
+        for r, got in enumerate(res):
+            np.testing.assert_array_equal(got, np.full(3, (r + 1) % 3))
+
+    def test_accumulate_and_fetch_and_op_tickets(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            local = np.zeros(1, dtype=np.int64)
+            win = MPI.Win.Create(local, comm=comm)
+            win.Accumulate(np.array([r + 1]), 0, op=MPI.SUM)
+            win.Fence()
+            total = int(local[0]) if r == 0 else None
+            # fetch-and-add hands every rank a distinct ticket off
+            # rank 0's counter (deterministic source-rank order).
+            pre = np.empty(1, dtype=np.int64)
+            win.Fetch_and_op(np.array([1]), pre, 0, op=MPI.SUM)
+            win.Fence()
+            win.Free()
+            MPI.Finalize()
+            return total, int(pre[0])
+
+        res = run_spmd(main, n=4)
+        assert res[0][0] == 1 + 2 + 3 + 4
+        base = 10  # counter already holds the accumulate total
+        assert sorted(t for _, t in res) == [base, base + 1, base + 2,
+                                             base + 3]
+
+    def test_shared_query_zero_copy_on_xla_driver(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            local = np.full(2, float(r), dtype=np.float64)
+            win = MPI.Win.Create(local, comm=comm)
+            peer, unit = win.Shared_query((r + 1) % comm.Get_size())
+            ok = (unit == 8 and peer[0] == (r + 1) % comm.Get_size())
+            win.Free()
+            MPI.Finalize()
+            return ok
+
+        assert all(run_spmd(main, n=2))
+
+    def test_disp_unit_mismatch_raises(self):
+        def main():
+            MPI, comm = _world()
+            err = None
+            try:
+                MPI.Win.Create(np.zeros(2, np.float64), disp_unit=4,
+                               comm=comm)
+            except api.MpiError as e:
+                err = str(e)
+            comm.barrier()
+            MPI.Finalize()
+            return err
+
+        res = run_spmd(main, n=2)
+        assert all(r and "disp_unit" in r for r in res)
+
+
+class TestFile:
+    """Parallel IO through the mpi4py spelling (MPI.File over io.py)."""
+
+    def test_open_write_at_all_read_at_all(self, tmp_path):
+        path = str(tmp_path / "compat_io.bin")
+
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            f = MPI.File.Open(comm, path,
+                              MPI.MODE_CREATE | MPI.MODE_RDWR)
+            data = np.full(4, float(r), dtype=np.float64)
+            f.Write_at_all(r * data.nbytes, data)
+            back = np.empty(4, dtype=np.float64)
+            f.Read_at_all(((r + 1) % comm.Get_size()) * data.nbytes, back)
+            size = f.Get_size()
+            f.Close()
+            MPI.Finalize()
+            return back, size
+
+        res = run_spmd(main, n=3)
+        for r, (back, size) in enumerate(res):
+            np.testing.assert_array_equal(back, np.full(4, (r + 1) % 3))
+            assert size == 3 * 4 * 8
+
+    def test_set_view_write_all_read_all_roundtrip(self, tmp_path):
+        path = str(tmp_path / "compat_view.bin")
+
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            f = MPI.File.Open(comm, path,
+                              MPI.MODE_CREATE | MPI.MODE_RDWR)
+            f.Set_view(etype=np.int32, block=2)  # row-cyclic rank split
+            mine = np.arange(4, dtype=np.int32) + 100 * r
+            f.Write_all(mine)
+            back = np.empty(4, dtype=np.int32)
+            f.Read_all(back)
+            f.Close()
+            MPI.Finalize()
+            return back, mine
+
+        for back, mine in run_spmd(main, n=2):
+            np.testing.assert_array_equal(back, mine)
+
+    def test_rdwr_without_create_requires_existing(self, tmp_path):
+        path = str(tmp_path / "missing.bin")
+
+        def main():
+            MPI, comm = _world()
+            err = None
+            try:
+                MPI.File.Open(comm, path, MPI.MODE_RDWR)
+            except api.MpiError as e:
+                err = "does not exist" in str(e)
+            comm.barrier()
+            MPI.Finalize()
+            return err
+
+        assert all(run_spmd(main, n=2))
+
+    def test_write_ordered(self, tmp_path):
+        path = str(tmp_path / "ordered.bin")
+
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            f = MPI.File.Open(comm, path,
+                              MPI.MODE_CREATE | MPI.MODE_RDWR)
+            # Variable sizes: rank r contributes r+1 bytes of value r.
+            start = f.Write_ordered(bytes([r]) * (r + 1))
+            f.Sync()
+            whole = np.empty(f.Get_size(), dtype=np.uint8)
+            f.Read_at_all(0, whole)
+            f.Close()
+            MPI.Finalize()
+            return start, whole
+
+        res = run_spmd(main, n=3)
+        starts = [s for s, _ in res]
+        assert starts == [0, 1, 3]
+        np.testing.assert_array_equal(res[0][1], [0, 1, 1, 2, 2, 2])
+
+
+class TestCartcomm:
+    """Cartesian topology through the mpi4py spelling."""
+
+    def test_create_cart_topo_and_coords(self):
+        def main():
+            MPI, comm = _world()
+            cart = comm.Create_cart([2, 2], periods=[True, False])
+            out = (cart.Get_topo(), cart.coords,
+                   cart.Get_cart_rank(cart.Get_coords(cart.Get_rank())))
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=4)
+        for r, (topo, coords, roundtrip) in enumerate(res):
+            assert topo == ([2, 2], [1, 0], list(coords))
+            assert roundtrip == r
+        assert [c for _, c, _ in res] == [[0, 0], [0, 1], [1, 0], [1, 1]]
+
+    def test_shift_proc_null_at_edge_and_wraparound(self):
+        def main():
+            MPI, comm = _world()
+            cart = comm.Create_cart([2, 2], periods=[True, False])
+            out = (cart.Shift(0, 1), cart.Shift(1, 1))
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=4)
+        # Axis 0 periodic: always real ranks; axis 1 not: edges NULL.
+        from mpi_tpu.compat import PROC_NULL
+
+        for (s0, d0), (s1, d1) in res:
+            assert s0 != PROC_NULL and d0 != PROC_NULL
+        assert res[0][1] == (PROC_NULL, 1)   # (0,0): no left, right=(0,1)
+        assert res[1][1] == (0, PROC_NULL)   # (0,1): left=(0,0), no right
+        assert res[0][0] == (2, 2)           # wraps over periodic axis 0
+
+    def test_sub_slices_rows(self):
+        def main():
+            MPI, comm = _world()
+            cart = comm.Create_cart([2, 2])
+            row = cart.Sub([False, True])     # keep axis 1: row comms
+            val = cart.Get_rank()
+            out = (row.Get_size(), row.allreduce(val))
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=4)
+        assert [s for s, _ in res] == [2, 2, 2, 2]
+        assert [t for _, t in res] == [1, 1, 5, 5]

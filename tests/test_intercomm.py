@@ -317,6 +317,39 @@ class TestDistGraph:
         res = run_spmd(main, n=2)
         assert all(e is not None and "inconsistent" in e for e in res)
 
+    def test_erring_rank_not_blamed_on_compliant_ranks(self):
+        """ADVICE r2 (distgraph.py): a compliant rank that legitimately
+        declared k edges to an erring rank must NOT be reported with a
+        phantom "declares 0 edges" mismatch — the erring rank
+        advertises sentinel counts and only its real error appears."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            me = w.rank()
+            try:
+                # Rank 1's adjacency is invalid (out-of-range edge);
+                # ranks 0 and 2 legitimately declare edges to/from 1.
+                if me == 1:
+                    dist_graph_create_adjacent(
+                        w, sources=[0], destinations=[99])
+                else:
+                    dist_graph_create_adjacent(
+                        w, sources=[1] if me == 2 else [],
+                        destinations=[1] if me == 0 else [])
+                err = None
+            except MpiError as exc:
+                err = str(exc)
+            mpi_tpu.finalize()
+            return err
+
+        res = run_spmd(main, n=3)
+        # Everyone raises, the real error is attributed to rank 1 only,
+        # and no phantom count mismatch is derived anywhere.
+        assert all(e is not None for e in res)
+        for e in res:
+            assert "out of range" in e
+            assert "declares" not in e
+
     def test_self_edges_allowed(self):
         def main():
             mpi_tpu.init()

@@ -167,6 +167,81 @@ class TestRing:
             unlink_ring(name)
 
 
+    def test_midframe_timeout_poisons_native_handles(self):
+        """ADVICE r2: after a mid-frame -ETIMEDOUT the stream position
+        is inside a half-written frame; silently resuming a NEW frame
+        from the stale offset would corrupt the byte stream. The native
+        handle latches a poison flag instead: every later op fails
+        loudly (EPIPE) until the ring is closed."""
+        import errno as errnomod
+        import socket as socketmod
+
+        if native_mod.shmcore() is None:
+            pytest.skip(f"native shmcore unavailable: "
+                        f"{native_mod.build_error('shmcore')}")
+        name = f"/mpitpu-test-{uuid.uuid4().hex[:10]}"
+        creator = create_ring(name, 1 << 12)
+        attached = attach_ring(name)
+        try:
+            conn = ShmConn(creator, attached)
+            conn.settimeout(0.1)
+            # No reader drains: an 8 KiB payload cannot fit the 4 KiB
+            # ring, so the send strands mid-frame and times out.
+            with pytest.raises(socketmod.timeout):
+                conn.send_frame(0, 1, os.urandom(1 << 13))
+            # A NEW frame on the poisoned tx handle fails loudly and
+            # immediately (EPIPE), not silently corrupting the stream.
+            with pytest.raises(OSError) as exc:
+                conn.send_frame(0, 2, b"tiny")
+            assert exc.value.errno == errnomod.EPIPE
+            # Receive side: the header of the stranded frame IS
+            # readable, but its payload can never fully arrive — the
+            # payload timeout is mid-frame by definition, so the rx
+            # handle poisons too.
+            with pytest.raises(socketmod.timeout):
+                conn.recv_frame()
+            with pytest.raises(OSError) as exc:
+                conn.recv_frame()
+            assert exc.value.errno == errnomod.EPIPE
+        finally:
+            creator.mark_closed()
+            creator.close()
+            if attached is not None:
+                attached.close()
+            unlink_ring(name)
+
+    def test_python_side_abandonment_poisons_via_shm_abandon(self):
+        """The Python wrapper abandons a native op when ITS deadline
+        expires between -EINTR resumes; shm_abandon must latch poison
+        for mid-frame abandonment (or force=1) and leave a clean
+        handle retryable (force=0, no progress)."""
+        import ctypes
+        import errno as errnomod
+
+        if native_mod.shmcore() is None:
+            pytest.skip(f"native shmcore unavailable: "
+                        f"{native_mod.build_error('shmcore')}")
+        lib = native_mod.shmcore()
+        name = f"/mpitpu-test-{uuid.uuid4().hex[:10]}"
+        creator = create_ring(name, 1 << 12)
+        try:
+            h = creator._h
+            # Clean handle, no progress: abandonment does NOT poison.
+            assert lib.shm_abandon(h, 0) == 0
+            conn = ShmConn(creator, creator)
+            conn.send_frame(0, 1, b"still works")
+            assert bytes(conn.recv_frame()[2]) == b"still works"
+            # force=1 (e.g. a payload read whose header was consumed):
+            # poisons even at op_done == 0.
+            assert lib.shm_abandon(h, 1) == 1
+            with pytest.raises(OSError) as exc:
+                conn.send_frame(0, 2, b"x")
+            assert exc.value.errno == errnomod.EPIPE
+        finally:
+            creator.mark_closed()
+            creator.close()
+            unlink_ring(name)
+
 class TestNames:
     def test_session_key_binds_addrs_and_password(self):
         a = session_key(["x", "y"], "pw")

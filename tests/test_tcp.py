@@ -450,3 +450,61 @@ class TestProtocols:
         net.init()
         assert net.size() == 1
         net.finalize()
+
+    def test_tcp6_cluster_over_ipv6_loopback(self):
+        """proto="tcp6" with Go's bracket address syntax ("[::1]:p") —
+        full 2-rank bootstrap + p2p roundtrip over IPv6 (the reference
+        accepts any net-package protocol, network.go:26)."""
+        import socket as socketmod
+        import threading as threadingmod
+
+        import numpy as np
+
+        from mpi_tpu.backends.tcp import TcpNetwork
+
+        try:
+            probe = socketmod.socket(socketmod.AF_INET6,
+                                     socketmod.SOCK_STREAM)
+            probe.bind(("::1", 0))
+            probe.close()
+        except OSError:
+            pytest.skip("IPv6 loopback unavailable")
+
+        from conftest import _free_ports
+
+        ports = _free_ports(2)
+        addrs = sorted(f"[::1]:{p:05d}" for p in ports)
+        nets = [TcpNetwork(addr=a, addrs=list(addrs), timeout=20.0,
+                           proto="tcp6") for a in addrs]
+        errs = [None, None]
+        out = {}
+
+        def run(i):
+            try:
+                nets[i].init()
+                r = nets[i].rank()
+                if r == 0:
+                    nets[i].send(np.arange(4, dtype=np.float32), 1, 5)
+                else:
+                    out["got"] = nets[i].receive(source=0, tag=5)
+                nets[i].finalize()
+            except BaseException as exc:  # noqa: BLE001
+                errs[i] = exc
+
+        threads = [threadingmod.Thread(target=run, args=(i,), daemon=True)
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(40)
+        assert errs == [None, None], errs
+        np.testing.assert_array_equal(out["got"],
+                                      np.arange(4, dtype=np.float32))
+
+    def test_split_hostport_brackets(self):
+        from mpi_tpu.backends.tcp import _split_hostport
+
+        assert _split_hostport("[::1]:5000") == ("::1", 5000)
+        assert _split_hostport("[fe80::2]:08080") == ("fe80::2", 8080)
+        assert _split_hostport("127.0.0.1:5000") == ("127.0.0.1", 5000)
+        assert _split_hostport(":5000") == ("", 5000)
