@@ -133,6 +133,30 @@ def measure_train_step(d_model: int = 1024, n_layers: int = 8,
     from mpi_tpu.models import TransformerConfig
 
     attention = "flash" if jax.default_backend() == "tpu" else "dense"
+    # Autotune the flash block grid for THIS chip and shape before the
+    # model traces (the winner registers for the exact (seq, seq)
+    # attention shape the transformer's flash calls hit). The sweep
+    # table doubles as the kernel-level breakdown in the bench line.
+    # One sweep per (shape, backend) per process — the long-context
+    # leg re-tunes at its own sequence length.
+    tuned: dict = {}
+    if attention == "flash":
+        from mpi_tpu.ops import tune_flash_blocks
+
+        try:
+            best, table = tune_flash_blocks(
+                batch, seq, n_heads, d_model // n_heads, reps=2)
+            tuned = {"flash_block_q": best[0], "flash_block_k": best[1]}
+            if table:
+                # Errored configs stay visible ("err:...") — a config
+                # that cannot fit VMEM is part of the breakdown too.
+                tuned["flash_tune_table_ms"] = {
+                    f"{t['block_q']}x{t['block_k']}":
+                        t["ms"] if "ms" in t
+                        else f"err:{t.get('error', '?')[:60]}"
+                    for t in table}
+        except Exception as exc:  # noqa: BLE001 - tuning is best-effort
+            tuned = {"flash_tune_error": str(exc)[:200]}
     cfg = TransformerConfig(
         vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
         d_ff=d_ff, max_seq=seq + 1, dtype=jnp.bfloat16,
@@ -195,6 +219,7 @@ def measure_train_step(d_model: int = 1024, n_layers: int = 8,
         "peak_source": peak_src,
         "timing_method": timing_method,
         "loss_first_step": round(loss_v, 4),
+        **tuned,
     }
 
 
@@ -212,7 +237,7 @@ def measure_long_context(seq: int = 8192, d_model: int = 1024,
                            n_heads=n_heads, d_ff=d_ff, vocab=vocab,
                            batch=batch, seq=seq, short=short, long=long,
                            remat=True)
-    return {
+    out = {
         "long_ctx_seq": seq,
         "long_ctx_step_ms": r["train_step_ms"],
         "long_ctx_tokens_per_s": r["train_tokens_per_s"],
@@ -220,6 +245,10 @@ def measure_long_context(seq: int = 8192, d_model: int = 1024,
         "long_ctx_remat": True,
         "long_ctx_timing_method": r["timing_method"],
     }
+    if "flash_block_q" in r:
+        out["long_ctx_flash_blocks"] = (f"{r['flash_block_q']}x"
+                                        f"{r['flash_block_k']}")
+    return out
 
 
 def measure_decode(d_model: int = 1024, n_layers: int = 8, n_heads: int = 8,

@@ -12,9 +12,12 @@ from .attention import (
     dense_attention,
     flash_attention,
     flash_attention_with_lse,
+    flash_block_defaults,
     flash_chunk_bwd,
     merge_attention_chunks,
+    set_flash_block_defaults,
 )
+from .autotune import tune_flash_blocks
 from .decode_attention import flash_decode_attention
 from .ring_collectives import (
     ring_allgather,
@@ -28,7 +31,10 @@ __all__ = [
     "blockwise_attention",
     "flash_attention",
     "flash_attention_with_lse",
+    "flash_block_defaults",
     "flash_decode_attention",
+    "set_flash_block_defaults",
+    "tune_flash_blocks",
     "flash_chunk_bwd",
     "merge_attention_chunks",
     "ring_allgather",

@@ -28,6 +28,7 @@ kernels are new tpu-first work layered on it.
 from __future__ import annotations
 
 import functools
+import os
 import math
 from typing import Optional
 
@@ -333,24 +334,93 @@ def _should_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _env_flash_blocks():
+    env = os.environ.get("MPI_TPU_FLASH_BLOCKS", "")
+    if env:
+        try:
+            bq, sep, bk = env.partition(",")
+            if not sep:
+                raise ValueError("expected 'BQ,BK'")
+            return [int(bq), int(bk)]
+        except ValueError:
+            import warnings
+
+            # A bad env var must not kill every `import mpi_tpu`: warn
+            # and fall back to the shipped default.
+            warnings.warn(
+                f"mpi_tpu: ignoring malformed MPI_TPU_FLASH_BLOCKS="
+                f"{env!r} (expected 'BQ,BK', e.g. '256,512')",
+                stacklevel=2)
+    return [256, 512]
+
+
+# Default (block_q, block_k) used when flash_attention is called with
+# block sizes of None (every internal caller — transformer.py, ring
+# attention chunks). The shipped 256x512 comes from a v5e sweep
+# (128x128 keeps the MXU only ~30% as busy at s=1024); override per
+# device/shape with :func:`set_flash_block_defaults` (the
+# ops.autotune sweep does this) or MPI_TPU_FLASH_BLOCKS="bq,bk".
+_flash_block_default = _env_flash_blocks()
+
+
+def set_flash_block_defaults(block_q: int, block_k: int) -> None:
+    """Set the process-wide default flash block sizes (autotuner
+    output). Takes effect on the next trace; do not call between a
+    step's forward and backward."""
+    _flash_block_default[0] = int(block_q)
+    _flash_block_default[1] = int(block_k)
+
+
+def flash_block_defaults():
+    """Current process-wide default ``(block_q, block_k)``."""
+    return tuple(_flash_block_default)
+
+
+# (seq_q, seq_k) -> (block_q, block_k): shape-exact winners from the
+# autotune sweep, consulted at trace time BEFORE the global default —
+# so tuning at one shape can never degrade flash calls at another
+# (the sweep's winner at a short sequence is shrunk to divide it and
+# would be a bad global choice).
+_tuned_blocks: dict = {}
+
+
+def register_tuned_blocks(seq_q: int, seq_k: int, block_q: int,
+                          block_k: int) -> None:
+    """Record the autotuned block grid for an exact (seq_q, seq_k)
+    attention shape; default-block flash calls at that shape use it."""
+    _tuned_blocks[(int(seq_q), int(seq_k))] = (int(block_q),
+                                               int(block_k))
+
+
+def _resolve_blocks(block_q, block_k, seq_q=None, seq_k=None):
+    if block_q is None and block_k is None and seq_q is not None:
+        hit = _tuned_blocks.get((seq_q, seq_k))
+        if hit is not None:
+            return hit
+    return (_flash_block_default[0] if block_q is None else block_q,
+            _flash_block_default[1] if block_k is None else block_k)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True, block_q: int = 256,
-                    block_k: int = 512,
+                    causal: bool = True, block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Flash attention: Pallas TPU kernels, forward and backward.
 
     ``interpret=None`` auto-selects interpreter mode off-TPU so tests run
     on CPU against the same kernel code. Falls back to
-    :func:`blockwise_attention` when Pallas is unavailable. The default
-    block sizes come from a v5e sweep (128x128 keeps the MXU only ~30%
-    as busy as 256x512 at s=1024); :func:`_pick_block` shrinks them to
-    fit short sequences.
+    :func:`blockwise_attention` when Pallas is unavailable. Block sizes
+    of ``None`` take the process-wide defaults
+    (:func:`flash_block_defaults` — 256x512 from a v5e sweep unless the
+    :mod:`mpi_tpu.ops.autotune` sweep picked better for this shape);
+    :func:`_pick_block` shrinks them to fit short sequences.
     """
     itp = _should_interpret() if interpret is None else interpret
     if not _HAVE_PALLAS:  # pragma: no cover
+        _, bk = _resolve_blocks(block_q, block_k)
         k, v = _expand_grouped_kv(q, k, v)
-        return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+        return blockwise_attention(q, k, v, causal=causal, block_k=bk)
     # Same kernel as the residual-saving forward; the (b*h, 1, s) lse
     # output is dead here and DCE'd by XLA.
     return _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k,
@@ -409,6 +479,7 @@ def _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k, interpret):
     nothing is materialised group-times larger."""
     b, s, h, d = q.shape
     t = k.shape[1]
+    block_q, block_k = _resolve_blocks(block_q, block_k, s, t)
     bq = _pick_block(s, block_q)
     bk = _pick_block(t, block_k)
     qf, kf, vf, kv_index, _ = _gqa_layout(q, k, v)
@@ -459,6 +530,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
     b, s, h, d = q.shape
     t = k.shape[1]
     hk = k.shape[2]
+    block_q, block_k = _resolve_blocks(block_q, block_k, s, t)
     bq = _pick_block(s, block_q)
     bk = _pick_block(t, block_k)
     qf, kf, vf, kv_index, group = _gqa_layout(q, k, v)
@@ -522,7 +594,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
 
 
 def flash_attention_with_lse(q, k, v, causal: bool = True,
-                             block_q: int = 256, block_k: int = 512,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None,
                              interpret: Optional[bool] = None):
     """Forward flash attention that also returns the per-row log-sum-exp.
 
@@ -553,7 +626,8 @@ def merge_attention_chunks(o1, lse1, o2, lse2):
 
 
 def flash_chunk_bwd(q, k, v, out, lse, g, causal: bool = False,
-                    block_q: int = 256, block_k: int = 512,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """FA-2 backward for ONE (query-chunk, kv-chunk) pair against the
     *global* softmax: ``out``/``lse`` are the full-attention result rows
@@ -571,7 +645,8 @@ def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
     itp = _should_interpret() if interpret is None else interpret
     if not _HAVE_PALLAS:  # pragma: no cover
         ke, ve = _expand_grouped_kv(q, k, v)
-        out = blockwise_attention(q, ke, ve, causal=causal, block_k=block_k)
+        out = blockwise_attention(q, ke, ve, causal=causal,
+                                  block_k=_resolve_blocks(None, block_k)[1])
         return out, (q, k, v, None, None)
     out, lse = _flash_fwd_res_pallas(q, k, v, causal, block_q, block_k, itp)
     return out, (q, k, v, out, lse)
@@ -582,8 +657,9 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
     if out is None:  # pragma: no cover - pallas-less fallback
         def ref(q_, k_, v_):
             ke, ve = _expand_grouped_kv(q_, k_, v_)
-            return blockwise_attention(q_, ke, ve, causal=causal,
-                                       block_k=block_k)
+            return blockwise_attention(
+                q_, ke, ve, causal=causal,
+                block_k=_resolve_blocks(None, block_k)[1])
 
         _, vjp = jax.vjp(ref, q, k, v)
         return vjp(g)

@@ -169,3 +169,103 @@ def test_flash_gqa_rejects_indivisible_heads():
     kv = jnp.zeros((1, 16, 3, 8))
     with pytest.raises(ValueError, match="kv heads"):
         flash_attention(q, kv, kv)
+
+
+class TestAutotune:
+    def _shape(self):
+        return dict(batch=2, seq=64, heads=2, head_dim=16)
+
+    def test_sweep_picks_and_registers_shape_winner(self):
+        from mpi_tpu.ops import flash_block_defaults, tune_flash_blocks
+        from mpi_tpu.ops.attention import _tuned_blocks
+        from mpi_tpu.ops.autotune import _cache
+
+        _cache.clear()
+        _tuned_blocks.clear()
+        before = flash_block_defaults()
+        try:
+            best, table = tune_flash_blocks(
+                **self._shape(), candidates=[(32, 32), (64, 64)],
+                reps=1, include_bwd=False)
+            assert best in [(32, 32), (64, 64)]
+            timed = [t for t in table if "ms" in t]
+            assert len(timed) == 2
+            assert timed[0]["ms"] <= timed[1]["ms"]  # fastest-first
+            # The winner registers for the EXACT tuned shape; the
+            # process-wide default is untouched (a short-seq winner
+            # must not degrade other shapes).
+            assert _tuned_blocks[(64, 64)] == best
+            assert flash_block_defaults() == before
+            # Cache hit: same shape+candidates returns with no table.
+            best2, table2 = tune_flash_blocks(
+                **self._shape(), candidates=[(32, 32), (64, 64)],
+                reps=1, include_bwd=False)
+            assert best2 == best and table2 == []
+            # Different candidate list = different sweep, not a stale
+            # cache hit constrained to the old set.
+            best3, table3 = tune_flash_blocks(
+                **self._shape(), candidates=[(32, 32)],
+                reps=1, include_bwd=False)
+            assert best3 == (32, 32) and len(table3) == 1
+        finally:
+            _cache.clear()
+            _tuned_blocks.clear()
+
+    def test_registered_blocks_feed_flash_and_match_dense(self):
+        from mpi_tpu.ops.attention import _tuned_blocks, register_tuned_blocks
+
+        rng = np.random.default_rng(3)
+        q, k, v = (jnp.asarray(rng.standard_normal((2, 64, 2, 16)),
+                               jnp.float32) for _ in range(3))
+        try:
+            register_tuned_blocks(64, 64, 32, 32)
+            got = flash_attention(q, k, v, True)   # blocks default=None
+            want = dense_attention(q, k, v, causal=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-4, atol=2e-4)
+            # A different shape does NOT hit the (64, 64) entry: it
+            # falls back to the global default and still matches dense.
+            q2, k2, v2 = (jnp.asarray(
+                rng.standard_normal((1, 32, 2, 16)), jnp.float32)
+                for _ in range(3))
+            np.testing.assert_allclose(
+                np.asarray(flash_attention(q2, k2, v2, True)),
+                np.asarray(dense_attention(q2, k2, v2, causal=True)),
+                rtol=2e-4, atol=2e-4)
+        finally:
+            _tuned_blocks.clear()
+
+    def test_candidates_collapse_dedupes(self):
+        from mpi_tpu.ops import tune_flash_blocks
+        from mpi_tpu.ops.attention import _tuned_blocks
+        from mpi_tpu.ops.autotune import _cache
+
+        _cache.clear()
+        try:
+            # seq=32: every preference shrinks to (32, 32) — exactly one
+            # config must be timed.
+            _, table = tune_flash_blocks(
+                batch=1, seq=32, heads=2, head_dim=16,
+                candidates=[(128, 128), (256, 512), (512, 512)],
+                reps=1, include_bwd=False)
+            assert len(table) == 1
+        finally:
+            _cache.clear()
+            _tuned_blocks.clear()
+
+    def test_malformed_env_blocks_warns_not_crashes(self):
+        from mpi_tpu.ops import attention as A
+
+        import warnings
+
+        import os as osmod
+        osmod.environ["MPI_TPU_FLASH_BLOCKS"] = "256"
+        try:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                got = A._env_flash_blocks()
+            assert got == [256, 512]
+            assert any("malformed" in str(x.message) for x in w)
+        finally:
+            del osmod.environ["MPI_TPU_FLASH_BLOCKS"]
+        assert A._env_flash_blocks() == [256, 512]
