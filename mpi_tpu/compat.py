@@ -323,6 +323,31 @@ class Comm:
 
         return Cartcomm(cart_create(self._c, dims, periods))
 
+    def Create_dist_graph_adjacent(self, sources, destinations,
+                                   sourceweights=None, destweights=None,
+                                   info: Any = None,
+                                   reorder: bool = False
+                                   ) -> "Distgraphcomm":
+        """Distributed-graph communicator
+        (``MPI_Dist_graph_create_adjacent``). Weights and ``reorder``
+        are accepted and ignored (rank order is preserved; the native
+        graph engine is unweighted)."""
+        from .distgraph import dist_graph_create_adjacent
+
+        return Distgraphcomm(dist_graph_create_adjacent(
+            self._c, list(sources), list(destinations)))
+
+    def Create_intercomm(self, local_leader: int, peer_comm: "Comm",
+                         remote_leader: int, tag: int = 0
+                         ) -> "Intercomm":
+        """Intercommunicator between this comm's group and a disjoint
+        remote group (``MPI_Intercomm_create``); ``peer_comm`` is the
+        bridge both leaders share (typically ``COMM_WORLD``)."""
+        from .intercomm import create_intercomm
+
+        return Intercomm(create_intercomm(
+            self._c, local_leader, peer_comm._c, remote_leader, tag=tag))
+
 
 class Cartcomm(Comm):
     """mpi4py ``MPI.Cartcomm`` over :class:`mpi_tpu.comm.CartComm`."""
@@ -370,6 +395,144 @@ class Cartcomm(Comm):
 
     def Sub(self, remain_dims) -> "Cartcomm":
         return Cartcomm(self._c.sub(remain_dims))
+
+
+class Distgraphcomm(Comm):
+    """mpi4py ``MPI.Distgraphcomm`` over
+    :class:`mpi_tpu.distgraph.DistGraphComm`."""
+
+    def Get_dist_neighbors_count(self):
+        """(indegree, outdegree, weighted=False)."""
+        return (len(self._c.in_neighbors), len(self._c.out_neighbors),
+                False)
+
+    def Get_dist_neighbors(self):
+        """(sources, destinations, weights=None) — declaration order,
+        the order the neighbor collectives use."""
+        return (list(self._c.in_neighbors), list(self._c.out_neighbors),
+                None)
+
+    @property
+    def inedges(self) -> List[int]:
+        return list(self._c.in_neighbors)
+
+    @property
+    def outedges(self) -> List[int]:
+        return list(self._c.out_neighbors)
+
+    def neighbor_allgather(self, sendobj: Any) -> List[Any]:
+        """Send ``sendobj`` along every out-edge; one payload per
+        in-edge, in declaration order (MPI_Neighbor_allgather)."""
+        return self._c.neighbor_allgather(sendobj)
+
+    def neighbor_alltoall(self, sendobj: List[Any]) -> List[Any]:
+        """``sendobj[i]`` travels out-edge ``i``; returns one payload
+        per in-edge (MPI_Neighbor_alltoall)."""
+        return self._c.neighbor_alltoall(sendobj)
+
+
+class Intercomm:
+    """mpi4py ``MPI.Intercomm`` over :class:`mpi_tpu.intercomm
+    .Intercomm`. P2p addresses REMOTE ranks; ``allreduce`` returns the
+    remote group's reduction; rooted ops use the MPI root protocol —
+    ``root=MPI.ROOT`` on the root, ``MPI.PROC_NULL`` on its group
+    peers, the root's remote rank on the receiving side."""
+
+    def __init__(self, native):
+        self._c = native
+
+    @property
+    def native(self):
+        return self._c
+
+    def Get_rank(self) -> int:
+        return self._c.rank()
+
+    def Get_size(self) -> int:
+        return self._c.size()
+
+    def Get_remote_size(self) -> int:
+        return self._c.remote_size()
+
+    rank = property(Get_rank)
+    size = property(Get_size)
+    remote_size = property(Get_remote_size)
+
+    @staticmethod
+    def _root(root):
+        from .intercomm import ROOT as _NATIVE_ROOT
+
+        if root is ROOT_SENTINEL:
+            return _NATIVE_ROOT
+        if root == PROC_NULL:
+            return None
+        return root
+
+    def send(self, obj: Any, dest: int, tag: int = 0) -> None:
+        self._c.send(obj, dest, tag)
+
+    def recv(self, source: int = -1, tag: int = 0,
+             status: Optional[Status] = None) -> Any:
+        _check_tag_not_wild(tag, "recv")
+        if source == ANY_SOURCE:
+            raise api.MpiError(
+                "mpi_tpu.compat: intercomm recv needs an explicit "
+                "remote source rank")
+        obj = self._c.receive(source, tag)
+        if status is not None:
+            status.source, status.tag = source, tag
+        return obj
+
+    def sendrecv(self, sendobj: Any, dest: int, sendtag: int = 0,
+                 recvbuf: Any = None, source: int = -1,
+                 recvtag: Optional[int] = None) -> Any:
+        """mpi4py parameter order (``recvbuf`` accepted and ignored —
+        pickle path); ``recvtag`` defaults to ``sendtag``; distinct
+        tags run as concurrent isend + receive."""
+        if recvtag is None:
+            recvtag = sendtag
+        _check_tag_not_wild(recvtag, "sendrecv")
+        _check_tag_not_wild(sendtag, "sendrecv")
+        if sendtag == recvtag:
+            return self._c.sendrecv(sendobj, dest=dest, source=source,
+                                    tag=sendtag)
+        sreq = self._c.isend(sendobj, dest, sendtag)
+        obj = self._c.receive(source, recvtag)
+        sreq.wait()
+        return obj
+
+    def barrier(self) -> None:
+        self._c.barrier()
+
+    Barrier = barrier
+
+    def Free(self) -> None:
+        """Release the intercomm's private union communicator
+        (``MPI_Comm_free`` analogue)."""
+        self._c.free()
+
+    def allgather(self, sendobj: Any) -> List[Any]:
+        return self._c.allgather(sendobj)
+
+    def alltoall(self, sendobj: List[Any]) -> List[Any]:
+        return self._c.alltoall(sendobj)
+
+    def allreduce(self, sendobj: Any, op: Optional[Op] = None) -> Any:
+        return self._c.allreduce(sendobj, op=_op(op))
+
+    def bcast(self, obj: Any = None, root: Any = None) -> Optional[Any]:
+        return self._c.bcast(obj, root=self._root(root))
+
+    def reduce(self, sendobj: Any = None, op: Optional[Op] = None,
+               root: Any = None) -> Optional[Any]:
+        return self._c.reduce(sendobj, root=self._root(root),
+                              op=_op(op))
+
+    def Merge(self, high: bool = False) -> Comm:
+        """Collapse into an intracommunicator
+        (``MPI_Intercomm_merge``); the low (``high=False``) group
+        orders first."""
+        return Comm(self._c.merge(high=high))
 
 
 class Win:
@@ -612,6 +775,8 @@ ANY_TAG = -2
 # this shim's ANY_SOURCE/ANY_TAG values (implementations differ on the
 # exact integers; mpi4py code compares against the constant, not -1).
 PROC_NULL = -3
+# MPI.ROOT for the intercomm rooted-op protocol (the root's own side).
+ROOT_SENTINEL = -4
 
 # MPI_File amode bits (the ROMIO/MPICH values — mpi4py exposes the same
 # names; code combines them with |).
@@ -659,6 +824,7 @@ class _MPI:
     ANY_SOURCE = ANY_SOURCE
     ANY_TAG = ANY_TAG
     PROC_NULL = PROC_NULL
+    ROOT = ROOT_SENTINEL
     MODE_CREATE = MODE_CREATE
     MODE_RDONLY = MODE_RDONLY
     MODE_WRONLY = MODE_WRONLY
@@ -676,6 +842,8 @@ class _MPI:
     Request = Request
     Comm = Comm
     Cartcomm = Cartcomm
+    Distgraphcomm = Distgraphcomm
+    Intercomm = Intercomm
     Win = Win
     File = File
 

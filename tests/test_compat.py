@@ -509,3 +509,92 @@ class TestCartcomm:
         res = run_spmd(main, n=4)
         assert [s for s, _ in res] == [2, 2, 2, 2]
         assert [t for _, t in res] == [1, 1, 5, 5]
+
+
+class TestDistgraphcomm:
+    def test_adjacent_ring_neighbor_collectives(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            g = comm.Create_dist_graph_adjacent(
+                sources=[(r - 1) % n], destinations=[(r + 1) % n])
+            counts = g.Get_dist_neighbors_count()
+            srcs, dsts, w = g.Get_dist_neighbors()
+            ag = g.neighbor_allgather(f"from{r}")
+            a2a = g.neighbor_alltoall([{"payload": r}])
+            MPI.Finalize()
+            return counts, srcs, dsts, w, ag, a2a
+
+        res = run_spmd(main, n=3)
+        for r, (counts, srcs, dsts, w, ag, a2a) in enumerate(res):
+            assert counts == (1, 1, False)
+            assert srcs == [(r - 1) % 3] and dsts == [(r + 1) % 3]
+            assert w is None
+            assert ag == [f"from{(r - 1) % 3}"]
+            assert a2a == [{"payload": (r - 1) % 3}]
+
+
+class TestIntercomm:
+    def _make(self, MPI, comm):
+        """Split world into even/odd groups bridged by COMM_WORLD."""
+        r = comm.Get_rank()
+        side = r % 2
+        local = comm.Split(color=side, key=r)
+        # leaders: local rank 0 on each side; remote leader's WORLD rank
+        inter = local.Create_intercomm(0, comm, 1 - side, tag=3)
+        return inter, side
+
+    def test_remote_size_p2p_and_allreduce(self):
+        def main():
+            MPI, comm = _world()
+            inter, side = self._make(MPI, comm)
+            out = {"sizes": (inter.Get_size(), inter.Get_remote_size())}
+            # p2p addresses REMOTE rank: pair local rank i <-> remote i
+            me = inter.Get_rank()
+            out["echo"] = inter.sendrecv(f"s{side}r{me}", dest=me,
+                                         source=me, sendtag=9)
+            # distinct tags: each direction uses the SENDER's side as
+            # its tag (side 0 sends on 11, receives side 1's 12)
+            out["echo2"] = inter.sendrecv(
+                f"x{side}", dest=me, sendtag=11 + side, source=me,
+                recvtag=11 + (1 - side))
+            # allreduce returns the REMOTE group's sum
+            out["ar"] = inter.allreduce(np.int64(10 + side))
+            inter.Free()
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=4)
+        for r, out in enumerate(res):
+            side = r % 2
+            assert out["sizes"] == (2, 2)
+            assert out["echo"] == f"s{1 - side}r{r // 2}"
+            assert out["echo2"] == f"x{1 - side}"
+            assert int(out["ar"]) == 2 * (10 + (1 - side))
+
+    def test_rooted_bcast_with_root_protocol_and_merge(self):
+        def main():
+            MPI, comm = _world()
+            inter, side = self._make(MPI, comm)
+            me = inter.Get_rank()
+            if side == 0:
+                # root = local rank 1 of side 0; its peer passes
+                # PROC_NULL; receivers name remote rank 1.
+                root = MPI.ROOT if me == 1 else MPI.PROC_NULL
+                got = inter.bcast("payload" if me == 1 else None,
+                                  root=root)
+            else:
+                got = inter.bcast(root=1)
+            merged = inter.Merge(high=(side == 1))
+            order = (merged.Get_rank(),
+                     merged.allgather(comm.Get_rank()))
+            MPI.Finalize()
+            return got, order
+
+        res = run_spmd(main, n=4)
+        for r, (got, (mrank, worlds)) in enumerate(res):
+            side = r % 2
+            assert got == (None if side == 0 else "payload")
+            # low group (side 0 = world evens) first in merged order
+            assert worlds == [0, 2, 1, 3]
+            assert mrank == worlds.index(r)
