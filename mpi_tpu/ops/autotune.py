@@ -20,6 +20,8 @@ applied to kernel configs.
 
 from __future__ import annotations
 
+import json
+import os
 import statistics
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -45,6 +47,47 @@ DEFAULT_CANDIDATES: Tuple[Tuple[int, int], ...] = (
 # (shape key, backend) -> chosen (block_q, block_k); one sweep per
 # distinct shape per process.
 _cache: Dict[tuple, Tuple[int, int]] = {}
+
+
+def _disk_cache_path() -> Optional[str]:
+    """Optional cross-process cache file (MPI_TPU_TUNE_CACHE=path).
+    A TPU sweep costs one kernel compile per candidate — behind a slow
+    or flaky device tunnel that is minutes; persisting winners makes a
+    retried benchmark run free."""
+    return os.environ.get("MPI_TPU_TUNE_CACHE") or None
+
+
+def _disk_cache_load(key: tuple) -> Optional[Tuple[int, int]]:
+    path = _disk_cache_path()
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            rec = json.load(f).get(repr(key))
+        return (int(rec[0]), int(rec[1])) if rec else None
+    except (OSError, ValueError, TypeError, KeyError, IndexError,
+            AttributeError):
+        # Any malformed cache content — wrong JSON shape included —
+        # degrades to a re-sweep, never a crash.
+        return None
+
+
+def _disk_cache_store(key: tuple, best: Tuple[int, int]) -> None:
+    path = _disk_cache_path()
+    if not path:
+        return
+    try:
+        data = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+        data[repr(key)] = list(best)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except (OSError, ValueError):
+        pass  # best-effort; the in-process sweep result still applies
 
 
 def _time_once(fn, *args) -> float:
@@ -81,8 +124,16 @@ def tune_flash_blocks(batch: int, seq: int, heads: int, head_dim: int,
     kv = heads if kv_heads is None else kv_heads
     tk = seq if seq_k is None else seq_k
     cands = tuple(candidates) if candidates else DEFAULT_CANDIDATES
+    # device_kind, not just the backend name: a persisted winner tuned
+    # on one TPU generation must not be reused on another (the best
+    # grid shifts with the chip — module doc).
     key = (batch, seq, tk, heads, kv, head_dim, causal, include_bwd,
-           str(jnp.dtype(dtype)), jax.default_backend(), cands)
+           str(jnp.dtype(dtype)), jax.default_backend(),
+           jax.devices()[0].device_kind, cands)
+    if key not in _cache:
+        disk = _disk_cache_load(key)
+        if disk is not None:
+            _cache[key] = disk
     if key in _cache:
         best = _cache[key]
         if set_default:
@@ -137,6 +188,7 @@ def tune_flash_blocks(batch: int, seq: int, heads: int, head_dim: int,
     timed.sort(key=lambda t: t["ms"])
     best = (timed[0]["block_q"], timed[0]["block_k"])
     _cache[key] = best
+    _disk_cache_store(key, best)
     if set_default:
         register_tuned_blocks(seq, tk, *best)
     return best, timed + [t for t in table if "ms" not in t]

@@ -88,3 +88,13 @@ class TestServe:
         assert res.returncode == 0, (res.stdout[-400:], res.stderr[-400:])
         assert "speculative == greedy: True" in res.stdout
         assert "int8 output valid: True" in res.stdout
+
+
+@pytest.mark.integration
+class TestMpi4pyPort:
+    def test_unmodified_mpi4py_script_4_ranks(self):
+        res = _mpirun(4, "examples/mpi4py_port.py")
+        assert res.returncode == 0, res.stderr[-800:]
+        out = res.stdout
+        assert out.count("mpi4py surface OK") == 4
+        assert "pi=3.141593" in out

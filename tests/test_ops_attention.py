@@ -172,6 +172,12 @@ def test_flash_gqa_rejects_indivisible_heads():
 
 
 class TestAutotune:
+    @pytest.fixture(autouse=True)
+    def _no_ambient_disk_cache(self, monkeypatch):
+        # An inherited MPI_TPU_TUNE_CACHE would satisfy sweeps from
+        # disk and break the table-shape assertions below.
+        monkeypatch.delenv("MPI_TPU_TUNE_CACHE", raising=False)
+
     def _shape(self):
         return dict(batch=2, seq=64, heads=2, head_dim=16)
 
@@ -269,3 +275,39 @@ class TestAutotune:
         finally:
             del osmod.environ["MPI_TPU_FLASH_BLOCKS"]
         assert A._env_flash_blocks() == [256, 512]
+
+    def test_disk_cache_roundtrip(self, tmp_path, monkeypatch):
+        """MPI_TPU_TUNE_CACHE persists winners across processes: a
+        fresh in-process cache hits the disk entry and skips the
+        sweep entirely (no table)."""
+        from mpi_tpu.ops import tune_flash_blocks
+        from mpi_tpu.ops.attention import _tuned_blocks
+        from mpi_tpu.ops.autotune import _cache
+
+        path = str(tmp_path / "tune.json")
+        monkeypatch.setenv("MPI_TPU_TUNE_CACHE", path)
+        _cache.clear()
+        try:
+            best, table = tune_flash_blocks(
+                batch=1, seq=32, heads=2, head_dim=16,
+                candidates=[(32, 32)], reps=1, include_bwd=False)
+            assert table and best == (32, 32)
+            import os as osmod
+            assert osmod.path.exists(path)
+            # Simulate a new process: wipe the in-memory cache only.
+            _cache.clear()
+            best2, table2 = tune_flash_blocks(
+                batch=1, seq=32, heads=2, head_dim=16,
+                candidates=[(32, 32)], reps=1, include_bwd=False)
+            assert best2 == best and table2 == []
+            # Corrupt file degrades to a re-sweep, never a crash.
+            with open(path, "w") as f:
+                f.write("not json")
+            _cache.clear()
+            best3, table3 = tune_flash_blocks(
+                batch=1, seq=32, heads=2, head_dim=16,
+                candidates=[(32, 32)], reps=1, include_bwd=False)
+            assert best3 == best and table3
+        finally:
+            _cache.clear()
+            _tuned_blocks.clear()
