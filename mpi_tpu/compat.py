@@ -20,10 +20,15 @@ compiled XLA programs on TPU.
 One-sided RMA (``MPI.Win.Create`` + ``Put``/``Get``/``Accumulate``/
 ``Get_accumulate``/``Fetch_and_op``/``Fence``), parallel IO
 (``MPI.File.Open`` + ``Read_at``/``Write_at``/collective ``_all``
-variants/``Set_view``), and Cartesian topologies
-(``comm.Create_cart`` + ``Get_coords``/``Shift``/``Sub``) are wrapped
-over the native :mod:`mpi_tpu.window`, :mod:`mpi_tpu.io`, and
-:class:`mpi_tpu.comm.CartComm` subsystems.
+variants/``Set_view``), Cartesian topologies (``comm.Create_cart`` +
+``Get_coords``/``Shift``/``Sub``), distributed graphs
+(``Create_dist_graph_adjacent`` + neighbor collectives),
+intercommunicators (``Create_intercomm``/``Merge`` + the
+``MPI.ROOT``/``MPI.PROC_NULL`` rooted-op protocol), and groups
+(``Get_group``/``Incl``/``Excl``/``Translate_ranks``/
+``Create_group``) are wrapped over the native :mod:`mpi_tpu.window`,
+:mod:`mpi_tpu.io`, :class:`mpi_tpu.comm.CartComm`,
+:mod:`mpi_tpu.distgraph`, and :mod:`mpi_tpu.intercomm` subsystems.
 
 Scope honesty: this is the commonly-used core surface, not all of
 mpi4py (no derived datatypes beyond numpy dtypes, no dynamic process
@@ -337,6 +342,32 @@ class Comm:
         return Distgraphcomm(dist_graph_create_adjacent(
             self._c, list(sources), list(destinations)))
 
+    def Get_group(self) -> "Group":
+        """This comm's group (``MPI_Comm_group``): all ranks, comm
+        order."""
+        return Group(self, range(self.Get_size()))
+
+    def Create_group(self, group: "Group", tag: int = 0
+                     ) -> Optional["Comm"]:
+        """Communicator from an explicit subset
+        (``MPI_Comm_create_group``): collective among the group's
+        members ONLY. Non-members (who in MPI would receive
+        ``COMM_NULL``) must not call — the native engine's contract —
+        and get ``None`` returned if they do appear in no-op form."""
+        if group._parent != self:
+            # The group's ranks number in ITS parent communicator; a
+            # foreign group's ranks fed to this comm would build a
+            # communicator over the wrong processes (and, since the
+            # misresolution differs per process, likely hang the
+            # members-only bootstrap). mpi4py errors too.
+            raise api.MpiError(
+                "mpi_tpu.compat: Create_group with a group from a "
+                "different communicator")
+        me = self.Get_rank()
+        if me not in group._ranks:
+            return None
+        return Comm(self._c.create_group(group._ranks, tag=tag))
+
     def Create_intercomm(self, local_leader: int, peer_comm: "Comm",
                          remote_leader: int, tag: int = 0
                          ) -> "Intercomm":
@@ -395,6 +426,78 @@ class Cartcomm(Comm):
 
     def Sub(self, remain_dims) -> "Cartcomm":
         return Cartcomm(self._c.sub(remain_dims))
+
+
+class Group:
+    """mpi4py ``MPI.Group``: an ordered rank subset of a parent comm.
+
+    Ranks are the PARENT communicator's group ranks (as in MPI, where
+    a group born of ``Get_group`` numbers like its communicator);
+    ``Incl``/``Excl`` derive subsets, ``Create_group`` on the parent
+    materializes a communicator from one."""
+
+    def __init__(self, parent: "Comm", ranks):
+        self._parent = parent
+        self._ranks = tuple(int(r) for r in ranks)
+
+    def Get_size(self) -> int:
+        return len(self._ranks)
+
+    def Get_rank(self) -> int:
+        """This process's rank in the group, or ``MPI.UNDEFINED``."""
+        me = self._parent.Get_rank()
+        return (self._ranks.index(me) if me in self._ranks
+                else UNDEFINED)
+
+    size = property(Get_size)
+    rank = property(Get_rank)
+
+    @property
+    def ranks(self):
+        """Parent-comm ranks, in group order."""
+        return list(self._ranks)
+
+    def _check_range(self, r: int) -> int:
+        # MPI raises MPI_ERR_RANK for out-of-range group ranks; a
+        # Python negative-index wraparound would hand back a
+        # plausible-looking wrong group instead.
+        r = int(r)
+        if not 0 <= r < len(self._ranks):
+            raise api.MpiError(
+                f"mpi_tpu.compat: group rank {r} out of range "
+                f"[0, {len(self._ranks)})")
+        return r
+
+    def Incl(self, ranks) -> "Group":
+        """Subset containing ``ranks`` (group ranks), in that order."""
+        return Group(self._parent,
+                     [self._ranks[self._check_range(r)] for r in ranks])
+
+    def Excl(self, ranks) -> "Group":
+        """Subset with the given group ranks removed, order kept."""
+        drop = {self._check_range(r) for r in ranks}
+        return Group(self._parent,
+                     [m for i, m in enumerate(self._ranks)
+                      if i not in drop])
+
+    def Translate_ranks(self, ranks=None, other: "Group" = None):
+        """Map this group's ranks into ``other``'s numbering
+        (``MPI.UNDEFINED`` where absent). ``ranks=None`` means every
+        rank of this group, as in mpi4py."""
+        if other is None:
+            raise api.MpiError(
+                "mpi_tpu.compat: Translate_ranks needs a target group")
+        if ranks is None:
+            ranks = range(len(self._ranks))
+        out = []
+        for r in ranks:
+            m = self._ranks[self._check_range(r)]
+            out.append(other._ranks.index(m) if m in other._ranks
+                       else UNDEFINED)
+        return out
+
+    def Free(self) -> None:
+        """Groups hold no driver resources; provided for parity."""
 
 
 class Distgraphcomm(Comm):
@@ -777,6 +880,8 @@ ANY_TAG = -2
 PROC_NULL = -3
 # MPI.ROOT for the intercomm rooted-op protocol (the root's own side).
 ROOT_SENTINEL = -4
+# MPI.UNDEFINED: Group rank queries for processes outside the group.
+UNDEFINED = -32766
 
 # MPI_File amode bits (the ROMIO/MPICH values — mpi4py exposes the same
 # names; code combines them with |).
@@ -825,6 +930,7 @@ class _MPI:
     ANY_TAG = ANY_TAG
     PROC_NULL = PROC_NULL
     ROOT = ROOT_SENTINEL
+    UNDEFINED = UNDEFINED
     MODE_CREATE = MODE_CREATE
     MODE_RDONLY = MODE_RDONLY
     MODE_WRONLY = MODE_WRONLY
@@ -841,6 +947,7 @@ class _MPI:
     Status = Status
     Request = Request
     Comm = Comm
+    Group = Group
     Cartcomm = Cartcomm
     Distgraphcomm = Distgraphcomm
     Intercomm = Intercomm

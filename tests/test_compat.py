@@ -598,3 +598,82 @@ class TestIntercomm:
             # low group (side 0 = world evens) first in merged order
             assert worlds == [0, 2, 1, 3]
             assert mrank == worlds.index(r)
+
+
+class TestGroup:
+    def test_get_group_incl_excl_translate(self):
+        def main():
+            MPI, comm = _world()
+            g = comm.Get_group()
+            out = {"size": g.Get_size(), "rank": g.Get_rank()}
+            evens = g.Incl([0, 2])
+            out["evens"] = (evens.ranks, evens.Get_rank())
+            out["odds"] = g.Excl([0, 2]).ranks
+            # Translate world ranks into the evens group's numbering.
+            out["xlate"] = g.Translate_ranks([0, 1, 2], evens)
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=4)
+        from mpi_tpu.compat import UNDEFINED
+
+        for r, out in enumerate(res):
+            assert out["size"] == 4 and out["rank"] == r
+            assert out["evens"][0] == [0, 2]
+            assert out["evens"][1] == ([0, 2].index(r) if r in (0, 2)
+                                       else UNDEFINED)
+            assert out["odds"] == [1, 3]
+            assert out["xlate"] == [0, UNDEFINED, 1]
+
+    def test_create_group_members_only(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            g = comm.Get_group().Incl([1, 3, 0])   # explicit order
+            sub = comm.Create_group(g, tag=2)
+            if r in (0, 1, 3):
+                out = (sub.Get_rank(), sub.Get_size(),
+                       sub.allgather(r))
+                sub.Free()
+            else:
+                out = sub  # non-member: None, and it kept working
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=4)
+        # group order [1, 3, 0] defines the ranks
+        assert res[1] == (0, 3, [1, 3, 0])
+        assert res[3] == (1, 3, [1, 3, 0])
+        assert res[0] == (2, 3, [1, 3, 0])
+        assert res[2] is None
+
+    def test_group_rank_validation_and_foreign_group(self):
+        def main():
+            MPI, comm = _world()
+            g = comm.Get_group()
+            errs = []
+            try:
+                g.Incl([-1])
+            except api.MpiError:
+                errs.append("incl")
+            try:
+                g.Translate_ranks([5], g)
+            except api.MpiError:
+                errs.append("xlate")
+            # None = all ranks (mpi4py default)
+            full = g.Translate_ranks(None, g.Incl([1]))
+            sub = comm.Split(color=comm.Get_rank() % 2,
+                             key=comm.Get_rank())
+            try:
+                comm.Create_group(sub.Get_group())
+            except api.MpiError:
+                errs.append("foreign")
+            MPI.Finalize()
+            return errs, full
+
+        res = run_spmd(main, n=2)
+        from mpi_tpu.compat import UNDEFINED
+
+        for errs, full in res:
+            assert errs == ["incl", "xlate", "foreign"]
+            assert full == [UNDEFINED, 0]
