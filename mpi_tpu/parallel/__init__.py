@@ -29,11 +29,16 @@ from .ring_attention import (
 from .halo import halo_exchange, jacobi_step_1d, jacobi_step_2d
 from .pipeline import pipeline, pipeline_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
+from .quantized import (dequantize_blocks, quantize_blocks,
+                        quantized_allreduce)
 from .cache_parallel import (cache_parallel_decode_attention,
                              merge_decode_partials)
 from .zero import constrain_opt_state, shard_opt_state, zero1_specs
 
 __all__ = [
+    "quantized_allreduce",
+    "quantize_blocks",
+    "dequantize_blocks",
     "make_mesh",
     "mesh_devices",
     "rank_axis",

@@ -1,0 +1,129 @@
+"""Int8-quantized allreduce (parallel/quantized.py): error-bound,
+padding, dtype, and degenerate-case contracts on the virtual 8-mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_tpu.parallel import (make_mesh, quantize_blocks,
+                              quantized_allreduce)
+
+
+def _run(x_per_rank, n=8, block=64, dtype=jnp.float32):
+    """Run the collective over an n-device mesh; returns (n, ...) out."""
+    mesh = make_mesh(n)
+    xs = jnp.asarray(x_per_rank, dtype)  # (n, ...)
+
+    body = jax.shard_map(
+        lambda v: quantized_allreduce(v[0], "rank", block=block)[None],
+        mesh=mesh, in_specs=P("rank"), out_specs=P("rank"),
+        check_vma=False)
+    sharding = NamedSharding(mesh, P("rank"))
+    return np.asarray(jax.jit(body)(jax.device_put(xs, sharding)))
+
+
+def test_error_within_analytic_bound():
+    """|err| <= 0.5 * (sum_i scale1_i + scale2) elementwise — the
+    two-rounding bound the module doc promises."""
+    rng = np.random.default_rng(0)
+    n, m, block = 8, 4096, 64
+    xs = rng.standard_normal((n, m)).astype(np.float32) * \
+        rng.uniform(0.1, 10, (n, 1)).astype(np.float32)
+    want = xs.sum(0)
+    got = _run(xs, n=n, block=block)
+    # every rank agrees
+    for r in range(1, n):
+        np.testing.assert_array_equal(got[r], got[0])
+    # analytic bound: phase-1 scales per rank + phase-2 scale on the sum
+    s1 = np.stack([np.asarray(quantize_blocks(
+        jnp.asarray(x), block)[1]) for x in xs])        # (n, nblk, 1)
+    bound1 = 0.5 * s1.sum(0)                             # (nblk, 1)
+    # phase-2 scale from the EXACT partial is within 1.5x of the true
+    # one (quantization of phase 1 can grow amax slightly); use a
+    # conservative doubling.
+    s2 = np.asarray(quantize_blocks(jnp.asarray(want), block)[1])
+    bound = (bound1 + 1.0 * s2).repeat(block, 1).reshape(-1)
+    err = np.abs(got[0] - want)
+    assert (err <= bound + 1e-6).all(), float((err - bound).max())
+    # and it is actually close in relative terms
+    rel = np.abs(got[0] - want) / (np.abs(want) + 1.0)
+    assert float(rel.mean()) < 0.02
+
+
+def test_padding_non_multiple_sizes_and_shapes():
+    rng = np.random.default_rng(1)
+    n = 8
+    xs = rng.standard_normal((n, 3, 129)).astype(np.float32)  # 387 elems
+    got = _run(xs, n=n, block=64)
+    want = xs.sum(0)
+    assert got[0].shape == want.shape
+    np.testing.assert_allclose(got[0], want, rtol=0.1, atol=0.05)
+
+
+def test_bfloat16_roundtrip_dtype():
+    rng = np.random.default_rng(2)
+    xs = rng.standard_normal((8, 256)).astype(np.float32)
+    mesh = make_mesh(8)
+    body = jax.shard_map(
+        lambda v: quantized_allreduce(v[0], "rank", block=64)[None],
+        mesh=mesh, in_specs=P("rank"), out_specs=P("rank"),
+        check_vma=False)
+    out = jax.jit(body)(jax.device_put(
+        jnp.asarray(xs, jnp.bfloat16), NamedSharding(mesh, P("rank"))))
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out[0], dtype=np.float32),
+        xs.astype(np.float32).sum(0), rtol=0.15, atol=0.3)
+
+
+def test_zero_and_constant_blocks_exact():
+    """All-zero blocks survive exactly (scale guard), and a constant
+    amax-valued block survives both phases exactly: phase 1 carries
+    q=127 scale=1 per rank, the partial 8*127 quantizes to q=127
+    scale=8 — no rounding anywhere."""
+    n = 8
+    xs = np.zeros((n, 256), np.float32)
+    got = _run(xs, n=n, block=64)
+    np.testing.assert_array_equal(got[0], np.zeros(256, np.float32))
+    xs = np.full((n, 256), 127.0, np.float32)
+    got = _run(xs, n=n, block=64)
+    np.testing.assert_array_equal(got[0],
+                                  np.full(256, 8 * 127.0, np.float32))
+
+
+def test_nan_propagates_loudly():
+    """A NaN gradient element must surface as NaN in its block (as the
+    exact allreduce would surface it), never as finite garbage."""
+    n = 8
+    xs = np.ones((n, 256), np.float32)
+    xs[3, 10] = np.nan
+    got = _run(xs, n=n, block=64)
+    # the NaN element's whole block is NaN on every rank...
+    assert np.isnan(got[0][0:64]).all()
+    for r in range(n):
+        assert np.isnan(got[r][10])
+    # ...and untouched blocks reduce normally
+    np.testing.assert_allclose(got[0][64:], np.full(192, 8.0), rtol=0.05)
+
+
+def test_inf_propagates_as_nan():
+    n = 8
+    xs = np.ones((n, 128), np.float32)
+    xs[0, 0] = np.inf
+    got = _run(xs, n=n, block=64)
+    assert np.isnan(got[0][:64]).any() or np.isinf(got[0][:64]).any()
+    np.testing.assert_allclose(got[0][64:], np.full(64, 8.0), rtol=0.05)
+
+
+def test_integer_dtype_rejected():
+    mesh = make_mesh(8)
+    body = jax.shard_map(
+        lambda v: quantized_allreduce(v[0], "rank")[None],
+        mesh=mesh, in_specs=P("rank"), out_specs=P("rank"),
+        check_vma=False)
+    with pytest.raises(TypeError, match="float payloads"):
+        jax.jit(body)(jax.device_put(
+            jnp.ones((8, 1, 64), jnp.int32),
+            NamedSharding(mesh, P("rank"))))
