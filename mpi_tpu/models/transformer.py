@@ -481,7 +481,7 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                      learning_rate: float = 1e-3, grad_accum: int = 1,
                      optimizer: str = "adamw", warmup_steps: int = 0,
                      total_steps: Optional[int] = None,
-                     zero1: bool = False):
+                     zero1: bool = False, fsdp: bool = False):
     """Build (init_state, step_body) with ``step_body`` left un-jitted —
     for callers that embed the step in a larger program (the bench
     harness scans it; :func:`make_train_step` jits it as-is). Both
@@ -502,7 +502,17 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     then turns the dp gradient psum into a reduce-scatter, updates
     each device's 1/dp state shard, and all-gathers the fresh params —
     AdamW state memory drops ~dp-fold with the same step math up to
-    float reduction order."""
+    float reduction order.
+
+    ``fsdp=True`` (ZeRO-3: requires a mesh with a ``dp`` axis) shards
+    the PARAMETERS themselves over ``dp`` on top of any tp layout
+    (:func:`mpi_tpu.parallel.zero.fsdp_specs`) — parameter AND
+    optimizer memory drop ~dp-fold; GSPMD inserts just-in-time weight
+    all-gathers per layer (re-run in the backward under ``cfg.remat``)
+    and reduce-scatters the gradients straight into the shard. Same
+    step math as plain dp up to float reduction order. Subsumes
+    ``zero1`` (the optimizer state follows the sharded parameters);
+    combining both flags is an error."""
     import optax
 
     if grad_accum < 1:
@@ -511,6 +521,13 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     if zero1 and (mesh is None or "dp" not in mesh.axis_names):
         raise ValueError(
             "mpi_tpu: zero1=True needs a mesh with a 'dp' axis")
+    if fsdp and (mesh is None or "dp" not in mesh.axis_names):
+        raise ValueError(
+            "mpi_tpu: fsdp=True needs a mesh with a 'dp' axis")
+    if fsdp and zero1:
+        raise ValueError(
+            "mpi_tpu: fsdp subsumes zero1 (optimizer state follows the "
+            "dp-sharded parameters); pass only fsdp=True")
     if mesh is not None and "tp" in mesh.axis_names:
         tp = mesh.shape["tp"]
         if cfg.n_heads % tp or cfg.kv_heads % tp:
@@ -524,9 +541,29 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     def _sane_param_specs(params):
         return sane_param_specs(cfg, params, mesh)
 
+    def _fsdp_specs(params):
+        from ..parallel.zero import fsdp_specs
+
+        return fsdp_specs(params, _sane_param_specs(params), mesh)
+
     def init_state(key: jax.Array):
         if mesh is not None:
             params = init_sharded_params(key, cfg, mesh)
+            if fsdp:
+                from ..parallel.zero import (shard_opt_state,
+                                             zero1_specs)
+
+                fspecs = _fsdp_specs(params)
+                params = jax.tree.map(
+                    lambda x, s: jax.device_put(
+                        x, NamedSharding(mesh, s)), params, fspecs)
+                opt_state = jax.jit(opt.init)(params)
+                # State leaves match param shapes, and _leaf_spec is a
+                # no-op when dp is already claimed — so this commits
+                # the moments to the SAME fully-sharded layouts.
+                zspecs = zero1_specs(params, fspecs, opt_state, mesh)
+                opt_state = shard_opt_state(opt_state, zspecs, mesh)
+                return {"params": params, "opt": opt_state}
             opt_state = jax.jit(opt.init)(params)
             if zero1:
                 from ..parallel.zero import shard_opt_state, zero1_specs
@@ -563,6 +600,25 @@ def make_train_parts(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
         return loss_sum * inv, jax.tree.map(lambda g: g * inv, gsum)
 
     def step(state, tokens):
+        if fsdp:
+            from ..parallel.zero import (constrain_opt_state,
+                                         constrain_params, zero1_specs)
+
+            # Pin weights/grads/state to the fully-sharded layouts at
+            # the step boundary so GSPMD keeps the JIT-gather +
+            # grad-reduce-scatter plan instead of replicating between
+            # steps (specs derive from the state itself, so restored
+            # checkpoints behave identically).
+            fspecs = _fsdp_specs(state["params"])
+            params0 = constrain_params(state["params"], fspecs, mesh)
+            loss, grads = accumulate(params0, tokens)
+            grads = constrain_params(grads, fspecs, mesh)
+            updates, new_opt = opt.update(grads, state["opt"], params0)
+            new_params = constrain_params(
+                optax.apply_updates(params0, updates), fspecs, mesh)
+            zspecs = zero1_specs(state["params"], fspecs, new_opt, mesh)
+            new_opt = constrain_opt_state(new_opt, zspecs, mesh)
+            return {"params": new_params, "opt": new_opt}, loss
         loss, grads = accumulate(state["params"], tokens)
         updates, new_opt = opt.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
@@ -588,7 +644,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                     learning_rate: float = 1e-3, grad_accum: int = 1,
                     optimizer: str = "adamw", warmup_steps: int = 0,
                     total_steps: Optional[int] = None,
-                    zero1: bool = False):
+                    zero1: bool = False, fsdp: bool = False):
     """Build (init_state, step). ``step(state, tokens) -> (state, loss)``
     is one fully jitted optimizer step; with a mesh, params/opt-state are
     committed to :func:`param_specs` shardings and the batch to
@@ -601,7 +657,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                                         optimizer=optimizer,
                                         warmup_steps=warmup_steps,
                                         total_steps=total_steps,
-                                        zero1=zero1)
+                                        zero1=zero1, fsdp=fsdp)
     # Donate the incoming state: params + optimizer state alias their
     # output buffers, halving peak HBM for the largest tensors in the
     # step (the standard TPU training setup; callers rebind

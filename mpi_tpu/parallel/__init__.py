@@ -33,7 +33,8 @@ from .quantized import (dequantize_blocks, quantize_blocks,
                         quantized_allreduce)
 from .cache_parallel import (cache_parallel_decode_attention,
                              merge_decode_partials)
-from .zero import constrain_opt_state, shard_opt_state, zero1_specs
+from .zero import (constrain_opt_state, constrain_params, fsdp_specs,
+                   shard_opt_state, zero1_specs)
 
 __all__ = [
     "quantized_allreduce",
@@ -43,6 +44,8 @@ __all__ = [
     "mesh_devices",
     "rank_axis",
     "zero1_specs",
+    "fsdp_specs",
+    "constrain_params",
     "shard_opt_state",
     "constrain_opt_state",
     "cache_parallel_decode_attention",
