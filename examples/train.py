@@ -47,6 +47,9 @@ def main() -> None:
                          "default: synthetic stream")
     ap.add_argument("--zero1", action="store_true",
                     help="shard optimizer state over dp (ZeRO-1)")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="fully shard the parameters over dp "
+                         "(ZeRO-3/FSDP; subsumes --zero1)")
     ap.add_argument("--lora", type=int, default=0, metavar="RANK",
                     help="freeze the base model and train rank-RANK "
                          "LoRA adapters instead (adapter-only state)")
@@ -104,6 +107,7 @@ def main() -> None:
         unsupported = [n for n, v in (("--grad-accum", args.grad_accum > 1),
                                       ("--warmup-steps", args.warmup_steps),
                                       ("--zero1", args.zero1),
+                                      ("--fsdp", args.fsdp),
                                       ("--resume", args.resume)) if v]
         if unsupported:
             raise SystemExit(
@@ -120,7 +124,7 @@ def main() -> None:
             cfg, mesh=mesh, learning_rate=1e-2, grad_accum=args.grad_accum,
             optimizer=args.optimizer, warmup_steps=args.warmup_steps,
             total_steps=start + args.steps if args.warmup_steps else None,
-            zero1=args.zero1)
+            zero1=args.zero1, fsdp=args.fsdp)
     state = init_state(jax.random.PRNGKey(0))
     if start:
         state = restore_checkpoint(args.checkpoint_dir, state)
