@@ -474,3 +474,39 @@ def test_fsdp_checkpoint_roundtrip_resumes_identically():
         _, l3b = step_f(r2, toks)
     assert float(l2a) == pytest.approx(float(l2b), rel=1e-5)
     assert float(l3a) == pytest.approx(float(l3b), rel=1e-5)
+
+
+def test_fsdp_composes_with_moe_and_gqa_tp():
+    """fsdp_specs claims a FREE axis only: expert weights keep their ep
+    sharding, attention weights their tp sharding — and the step still
+    matches the plain-dp run at each composition."""
+    from jax.sharding import Mesh
+
+    # MoE over dp x ep
+    devs = np.asarray(jax.devices()[:8]).reshape(4, 2)
+    mesh_ep = Mesh(devs, ("dp", "ep"))
+    cfg_moe = dataclasses_replace(CFG, n_experts=2, moe_top_k=2)
+    toks = _tokens(batch=8, seq=17)
+    init_p, step_p = make_train_step(cfg_moe, mesh=mesh_ep)
+    init_f, step_f = make_train_step(cfg_moe, mesh=mesh_ep, fsdp=True)
+    s_p, s_f = init_p(jax.random.PRNGKey(0)), init_f(jax.random.PRNGKey(0))
+    for _ in range(2):
+        s_p, lp = step_p(s_p, toks)
+        s_f, lf = step_f(s_f, toks)
+        assert float(lp) == pytest.approx(float(lf), rel=3e-4)
+
+    # GQA under dp x sp x tp
+    mesh = make_mesh_nd(8)
+    cfg_gqa = dataclasses_replace(CFG, n_kv_heads=2)
+    init_p, step_p = make_train_step(cfg_gqa, mesh=mesh)
+    init_f, step_f = make_train_step(cfg_gqa, mesh=mesh, fsdp=True)
+    s_p, s_f = init_p(jax.random.PRNGKey(0)), init_f(jax.random.PRNGKey(0))
+    toks4 = _tokens(batch=4, seq=17)
+    for _ in range(2):
+        s_p, lp = step_p(s_p, toks4)
+        s_f, lf = step_f(s_f, toks4)
+        assert float(lp) == pytest.approx(float(lf), rel=3e-4)
+    # wq is (d, h, hd) with tp on heads: fsdp claims axis 0 ->
+    # tp x dp = 4 distinct shard patterns
+    wq = s_f["params"]["blocks"][0]["wq"]
+    assert len({s.index for s in wq.addressable_shards}) == 4
