@@ -8,8 +8,10 @@ an mpi4py-style script run on this framework by changing ONE line —
 
 — after which ``MPI.COMM_WORLD``, ``Get_rank``/``Get_size``, lowercase
 pickle-based p2p/collectives (``send``/``recv``/``bcast``/``allreduce``
-/...), uppercase buffer-based ``Send``/``Recv``/``Bcast``/``Allreduce``
-(numpy arrays; the capital-letter convention for typed buffers),
+/...), uppercase buffer-based ``Send``/``Recv``/``Bcast``/``Allreduce``/
+``Reduce``/``Allgather``/``Gather``/``Scatter``/``Alltoall``/
+``Reduce_scatter`` (numpy arrays; the capital-letter convention for
+typed buffers),
 ``Split``/``Dup``/``Free``, nonblocking ``isend``/``irecv`` returning
 ``wait()``-able requests, ``ANY_SOURCE`` receives with a ``Status``,
 and the op constants (``SUM``/``PROD``/``MIN``/``MAX``) behave as an
@@ -241,12 +243,12 @@ class Comm:
     def Recv(self, buf: Any, source: int = -1, tag: int = 0,
              status: Optional[Status] = None) -> None:
         _check_tag_not_wild(tag, "Recv")
-        out = _writable_buffer(buf, "Recv")
+        _writable_buffer(buf, "Recv")  # validate before communicating
         if source == ANY_SOURCE:
             src, got = self._c.receive_any(tag)
         else:
             src, got = source, self._c.receive(source, tag)
-        np.copyto(out, np.asarray(got).reshape(out.shape))
+        _fill(buf, got, "Recv")
         if status is not None:
             status.source, status.tag = src, tag
 
@@ -265,21 +267,74 @@ class Comm:
         got = self._c.bcast(
             np.ascontiguousarray(out) if self.Get_rank() == root else None,
             root=root)
-        np.copyto(out, np.asarray(got).reshape(out.shape))
+        _fill(buf, got, "Bcast")
 
     def allreduce(self, sendobj: Any, op: "Op" = None) -> Any:
         return self._c.allreduce(sendobj, op=_op(op))
 
     def Allreduce(self, sendbuf: Any, recvbuf: Any,
                   op: "Op" = None) -> None:
-        out = _writable_buffer(recvbuf, "Allreduce")
+        _writable_buffer(recvbuf, "Allreduce")
         got = self._c.allreduce(np.ascontiguousarray(sendbuf),
                                 op=_op(op))
-        np.copyto(out, np.asarray(got).reshape(out.shape))
+        _fill(recvbuf, got, "Allreduce")
 
     def reduce(self, sendobj: Any, op: "Op" = None,
                root: int = 0) -> Optional[Any]:
         return self._c.reduce(sendobj, root=root, op=_op(op))
+
+    def Reduce(self, sendbuf: Any, recvbuf: Any, op: "Op" = None,
+               root: int = 0) -> None:
+        got = self._c.reduce(np.ascontiguousarray(sendbuf), root=root,
+                             op=_op(op))
+        if self.Get_rank() == root:
+            _fill(recvbuf, got, "Reduce")
+
+    def Allgather(self, sendbuf: Any, recvbuf: Any) -> None:
+        """Buffer allgather: ``recvbuf`` holds every rank's sendbuf
+        stacked in rank order (shape ``(size, *sendbuf.shape)`` or any
+        same-size reshape of it)."""
+        got = self._c.allgather(np.ascontiguousarray(sendbuf))
+        _fill_stacked(recvbuf, got, "Allgather")
+
+    def Gather(self, sendbuf: Any, recvbuf: Any, root: int = 0) -> None:
+        got = self._c.gather(np.ascontiguousarray(sendbuf), root=root)
+        if self.Get_rank() == root:
+            _fill_stacked(recvbuf, got, "Gather")
+
+    def Scatter(self, sendbuf: Any, recvbuf: Any, root: int = 0) -> None:
+        """Buffer scatter: the root's ``sendbuf`` splits along its
+        leading axis (which must equal the comm size)."""
+        if self.Get_rank() == root:
+            arr = np.ascontiguousarray(sendbuf)
+            _leading_axis_is_size(arr, self.Get_size(), "Scatter")
+            parts: Optional[List[Any]] = list(arr)
+        else:
+            parts = None
+        got = self._c.scatter(parts, root=root)
+        _fill(recvbuf, got, "Scatter")
+
+    def Alltoall(self, sendbuf: Any, recvbuf: Any) -> None:
+        """Buffer all-to-all: leading axis = comm size on both sides;
+        row j of ``sendbuf`` goes to rank j."""
+        arr = np.ascontiguousarray(sendbuf)
+        _leading_axis_is_size(arr, self.Get_size(), "Alltoall")
+        got = self._c.alltoall(list(arr))
+        _fill_stacked(recvbuf, got, "Alltoall")
+
+    def Reduce_scatter(self, sendbuf: Any, recvbuf: Any,
+                       recvcounts: Any = None, op: "Op" = None) -> None:
+        """Equal-block reduce-scatter (``MPI_Reduce_scatter_block``
+        semantics): ``sendbuf`` reduces elementwise across ranks and
+        this rank receives its 1/size block. ``recvcounts`` is
+        accepted only as equal blocks."""
+        if recvcounts is not None and len(set(recvcounts)) != 1:
+            raise api.MpiError(
+                "mpi_tpu.compat: Reduce_scatter supports equal "
+                "recvcounts only (MPI_Reduce_scatter_block)")
+        got = self._c.reduce_scatter(np.ascontiguousarray(sendbuf),
+                                     op=_op(op))
+        _fill(recvbuf, got, "Reduce_scatter")
 
     def gather(self, sendobj: Any, root: int = 0) -> Optional[List[Any]]:
         return self._c.gather(sendobj, root=root)
@@ -912,6 +967,27 @@ def _writable_buffer(buf: Any, what: str) -> np.ndarray:
         raise api.MpiError(
             f"mpi_tpu.compat: {what} receive buffer is read-only")
     return buf
+
+
+def _fill(buf: Any, got: Any, what: str) -> None:
+    """Copy a received payload into the caller's buffer through the
+    shared validation (one place to improve size/dtype diagnostics)."""
+    out = _writable_buffer(buf, what)
+    np.copyto(out, np.asarray(got).reshape(out.shape))
+
+
+def _fill_stacked(buf: Any, parts: Any, what: str) -> None:
+    """:func:`_fill` for list-of-payload results (rank order)."""
+    out = _writable_buffer(buf, what)
+    np.copyto(out, np.stack([np.asarray(p) for p in parts])
+              .reshape(out.shape))
+
+
+def _leading_axis_is_size(arr: np.ndarray, size: int, what: str) -> None:
+    if arr.ndim < 1 or arr.shape[0] != size:
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what} sendbuf needs leading axis == comm "
+            f"size {size}, got shape {arr.shape}")
 
 
 def _check_tag_not_wild(tag: int, what: str) -> None:

@@ -677,3 +677,96 @@ class TestGroup:
         for errs, full in res:
             assert errs == ["incl", "xlate", "foreign"]
             assert full == [UNDEFINED, 0]
+
+
+class TestBufferCollectives:
+    """Uppercase (typed-buffer) collectives beyond Bcast/Allreduce."""
+
+    def test_allgather_gather_scatter(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            mine = np.full(2, float(r))
+            ag = np.empty((n, 2))
+            comm.Allgather(mine, ag)
+            g = np.empty((n, 2)) if r == 1 else None
+            comm.Gather(mine, g, root=1)
+            if r == 0:
+                table = np.arange(n * 3, dtype=np.float64).reshape(n, 3)
+            else:
+                table = None
+            part = np.empty(3)
+            comm.Scatter(table, part, root=0)
+            MPI.Finalize()
+            return ag, g, part
+
+        res = run_spmd(main, n=3)
+        want_all = np.repeat(np.arange(3.0)[:, None], 2, 1)
+        for r, (ag, g, part) in enumerate(res):
+            np.testing.assert_array_equal(ag, want_all)
+            if r == 1:
+                np.testing.assert_array_equal(g, want_all)
+            else:
+                assert g is None
+            np.testing.assert_array_equal(
+                part, np.arange(r * 3, r * 3 + 3, dtype=np.float64))
+
+    def test_alltoall_reduce_reduce_scatter(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            send = np.asarray([[10 * r + j] for j in range(n)],
+                              np.float64)
+            recv = np.empty((n, 1))
+            comm.Alltoall(send, recv)
+            red = np.empty(2) if r == 0 else None
+            comm.Reduce(np.full(2, float(r + 1)), red, op=MPI.SUM,
+                        root=0)
+            vec = np.arange(n, dtype=np.float64) + r
+            rs = np.empty(1)
+            comm.Reduce_scatter(vec, rs)
+            MPI.Finalize()
+            return recv, red, rs
+
+        res = run_spmd(main, n=4)
+        for r, (recv, red, rs) in enumerate(res):
+            np.testing.assert_array_equal(
+                recv.reshape(-1), [10 * j + r for j in range(4)])
+            if r == 0:
+                np.testing.assert_array_equal(red, [10.0, 10.0])
+            # sum over src of (src + slot r) = 6 + 4r
+            np.testing.assert_array_equal(rs, [6.0 + 4 * r])
+
+    def test_scatter_0d_sendbuf_raises_mpi_error(self):
+        def main():
+            MPI, comm = _world()
+            err = None
+            if comm.Get_rank() == 0:
+                try:
+                    comm.Scatter(np.float64(3.0), np.empty(()), root=0)
+                except api.MpiError as e:
+                    err = "leading axis" in str(e)
+            else:
+                err = True
+            comm.barrier()
+            MPI.Finalize()
+            return err
+
+        assert all(run_spmd(main, n=2))
+
+    def test_scatter_wrong_leading_axis_raises(self):
+        def main():
+            MPI, comm = _world()
+            err = None
+            if comm.Get_rank() == 0:
+                try:
+                    comm.Scatter(np.zeros((5, 2)), np.empty(2), root=0)
+                except api.MpiError as e:
+                    err = "leading axis" in str(e)
+            else:
+                err = True  # only the root validates shape locally
+            comm.barrier()
+            MPI.Finalize()
+            return err
+
+        assert all(run_spmd(main, n=2))
