@@ -57,17 +57,43 @@ __all__ = ["MPI"]
 
 class Status:
     """Receive status (mpi4py ``MPI.Status``): filled by ``recv``/
-    ``Recv``/``probe`` with the actual source and tag."""
+    ``Recv``/``probe`` with the actual source and tag; receives also
+    record the payload size for :meth:`Get_count`."""
 
     def __init__(self) -> None:
         self.source: int = -1
         self.tag: int = -1
+        self.count: int = -1   # elements (arrays) / bytes (raw) / -1
 
     def Get_source(self) -> int:
         return self.source
 
     def Get_tag(self) -> int:
         return self.tag
+
+    def Get_count(self, datatype: Any = None) -> int:
+        """Received element count (numpy payloads count elements,
+        byte payloads bytes, other objects 1; -1 before any receive).
+        ``datatype`` is accepted and ignored — the payload carries its
+        own dtype here."""
+        return self.count
+
+    Get_elements = Get_count
+
+
+def _payload_count(obj: Any) -> int:
+    if isinstance(obj, np.ndarray):
+        return int(obj.size)
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return len(obj)
+    try:
+        import jax
+
+        if isinstance(obj, jax.Array):
+            return int(obj.size)
+    except Exception:  # noqa: BLE001 - jax absence never breaks Status
+        pass
+    return 1
 
 
 class Request:
@@ -156,6 +182,7 @@ class Comm:
             src, obj = source, self._c.receive(source, tag)
         if status is not None:
             status.source, status.tag = src, tag
+            status.count = _payload_count(obj)
         return obj
 
     def sendrecv(self, sendobj: Any, dest: int, sendtag: int = 0,
@@ -251,6 +278,7 @@ class Comm:
         _fill(buf, got, "Recv")
         if status is not None:
             status.source, status.tag = src, tag
+            status.count = _payload_count(np.asarray(got))
 
     # -- collectives --------------------------------------------------------
 

@@ -57,6 +57,7 @@ class TestBasics:
             if r == 0:
                 st = MPI.Status()
                 got = comm.recv(source=MPI.ANY_SOURCE, tag=7, status=st)
+                assert st.Get_count() == 1      # one pickled object
                 out = (got, st.Get_source(), st.Get_tag())
             else:
                 comm.send({"from": r}, dest=0, tag=7)
@@ -78,6 +79,7 @@ class TestBasics:
                 buf = np.empty(8, dtype=np.float64)
                 st = MPI.Status()
                 comm.Recv(buf, source=0, tag=1, status=st)
+                assert st.Get_count() == 8      # elements received
                 out = (buf.copy(), st.Get_source())
             MPI.Finalize()
             return out
