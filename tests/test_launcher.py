@@ -53,8 +53,11 @@ class TestEndToEnd:
         res = _run_cli(["--port-base", str(port), "--timeout", "30",
                         "4", "examples/helloworld.py"])
         assert res.returncode == 0, res.stderr
-        lines = [l for l in res.stdout.splitlines() if "<- rank" in l]
-        assert len(lines) == 16  # 4 ranks x 4 greetings
+        # Count records, not lines: the four children share one pipe,
+        # so two records can land on one line when a child's buffer
+        # flushes mid-line (observed ~1-in-3 under load) — the
+        # greetings are all present either way.
+        assert res.stdout.count("<- rank") == 16  # 4 ranks x 4 greetings
 
     def test_child_failure_propagates_exit_code(self, tmp_path):
         prog = tmp_path / "boom.py"
