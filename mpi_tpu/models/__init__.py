@@ -29,6 +29,8 @@ from .generate import decode_step, generate, prefill
 from .quant import QTensor, dequantize, quantize, quantize_params
 from .lora import (lora_init, make_lora_train_parts, make_lora_train_step,
                    merge_lora)
+from .vit import (ViTConfig, forward_vit, init_vit_params,
+                  make_vit_train_step)
 from .speculative import generate_lookahead
 from .pipeline_lm import (
     forward_pipelined,
@@ -54,6 +56,10 @@ __all__ = [
     "make_optimizer",
     "make_train_parts",
     "make_train_step",
+    "ViTConfig",
+    "forward_vit",
+    "init_vit_params",
+    "make_vit_train_step",
     "make_mesh_nd",
     "init_moe_params",
     "moe_ffn",

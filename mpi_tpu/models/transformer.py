@@ -104,6 +104,13 @@ class TransformerConfig:
     # the already-encoded values). head_dim must be even.
     rope: bool = False
     rope_theta: float = 10000.0
+    # Causal (autoregressive) masking. False gives a bidirectional
+    # encoder stack (ViT, BERT-style) through the same blocks — the
+    # dense/flash/blockwise kernels, the contiguous ring, and ulysses
+    # all take it directly; only the ZIGZAG layouts are causal-only
+    # (the work-balance trick assumes the triangular mask) and raise
+    # at the ring layer.
+    causal: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -267,11 +274,11 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     if impl == "flash":
         from ..ops import flash_attention
 
-        ctx = flash_attention(q, k, v)
+        ctx = flash_attention(q, k, v, cfg.causal)
     elif impl == "blockwise":
         from ..ops import blockwise_attention
 
-        ctx = blockwise_attention(q, k, v)
+        ctx = blockwise_attention(q, k, v, causal=cfg.causal)
     elif impl in ("ring", "zigzag", "ring_flash", "zigzag_flash"):
         from ..parallel.ring_attention import ring_attention_sharded
 
@@ -280,8 +287,12 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
                 f"attention_impl={impl!r} needs a mesh with an 'sp' axis")
         layout = "zigzag" if impl.startswith("zigzag") else "contiguous"
         chunk = "flash" if impl.endswith("_flash") else "fold"
+        # causal=False works on the contiguous ring; the zigzag layout
+        # is causal-only and ring_attention_sharded raises for it at
+        # its own layer (the balance trick assumes the triangle).
         ctx = ring_attention_sharded(q, k, v, mesh, axis_name="sp",
-                                     layout=layout, chunk_impl=chunk)
+                                     causal=cfg.causal, layout=layout,
+                                     chunk_impl=chunk)
     elif impl in ("ulysses", "ulysses_flash"):
         from ..parallel.ulysses import ulysses_attention_sharded
 
@@ -290,11 +301,12 @@ def _attention(x, blk, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
                 f"attention_impl={impl!r} needs a mesh with an 'sp' axis")
         kernel = "flash" if impl.endswith("_flash") else "blockwise"
         ctx = ulysses_attention_sharded(q, k, v, mesh, axis_name="sp",
+                                        causal=cfg.causal,
                                         kernel_impl=kernel)
     elif impl == "dense":
         from ..ops import dense_attention
 
-        ctx = dense_attention(q, k, v)
+        ctx = dense_attention(q, k, v, causal=cfg.causal)
     else:
         raise ValueError(
             f"unknown attention_impl {impl!r}: expected dense|flash|"
