@@ -53,6 +53,7 @@ import statistics
 import sys
 import threading
 import time
+from typing import Optional
 
 BOUNCE_SIZE = 1_000_000   # bytes — the 1e6 row of the bounce sweep
 BOUNCE_REPS = 10          # bounce.go:35
@@ -119,7 +120,8 @@ def measure_train_step(d_model: int = 1024, n_layers: int = 8,
                        n_heads: int = 8, d_ff: int = 4096,
                        vocab: int = 8192, batch: int = 8,
                        seq: int = 1024, short: int = 2, long: int = 10,
-                       remat: bool = False) -> dict:
+                       remat: bool = False,
+                       attention: Optional[str] = None) -> dict:
     """One fully-jitted AdamW step of the flagship Transformer at a real
     size (VERDICT round-1 item 1: d_model >= 1024, seq >= 1024, bf16,
     flash attention, on the real chip). Per-step time is the difference
@@ -132,7 +134,8 @@ def measure_train_step(d_model: int = 1024, n_layers: int = 8,
 
     from mpi_tpu.models import TransformerConfig
 
-    attention = "flash" if jax.default_backend() == "tpu" else "dense"
+    if attention is None:
+        attention = "flash" if jax.default_backend() == "tpu" else "dense"
     # Autotune the flash block grid for THIS chip and shape before the
     # model traces (the winner registers for the exact (seq, seq)
     # attention shape the transformer's flash calls hit). The sweep
