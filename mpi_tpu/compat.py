@@ -12,8 +12,10 @@ pickle-based p2p/collectives (``send``/``recv``/``bcast``/``allreduce``
 ``Reduce``/``Allgather``/``Gather``/``Scatter``/``Alltoall``/
 ``Reduce_scatter`` (numpy arrays; the capital-letter convention for
 typed buffers),
-``Split``/``Dup``/``Free``, nonblocking ``isend``/``irecv`` returning
-``wait()``-able requests, ``ANY_SOURCE`` receives with a ``Status``,
+``Split``/``Dup``/``Free``, nonblocking ``isend``/``irecv`` AND the
+MPI-3 nonblocking collectives (``iallreduce``/``ibcast``/``igather``/
+``iscatter``/``ialltoall``/``ibarrier``/...) returning ``wait()``-able
+requests, ``ANY_SOURCE`` receives with a ``Status``,
 and the op constants (``SUM``/``PROD``/``MIN``/``MAX``) behave as an
 mpi4py user expects — lowered onto whichever driver is active (tcp,
 xla, hybrid), so "mpi4py code" transparently runs its collectives as
@@ -382,6 +384,38 @@ class Comm:
 
     def exscan(self, sendobj: Any, op: "Op" = None) -> Optional[Any]:
         return self._c.exscan(sendobj, op=_op(op))
+
+    # -- nonblocking collectives (lowercase pickle, mpi4py-style) -----------
+    #
+    # Each returns a Request whose wait() yields what the blocking
+    # twin returns; launch order chains per communicator (the native
+    # _icoll contract), matching MPI's ordered-collectives rule.
+
+    def ibarrier(self) -> Request:
+        return Request(self._c.ibarrier())
+
+    def iallreduce(self, sendobj: Any, op: "Op" = None) -> Request:
+        return Request(self._c.iallreduce(sendobj, op=_op(op)))
+
+    def ireduce(self, sendobj: Any, op: "Op" = None,
+                root: int = 0) -> Request:
+        return Request(self._c.ireduce(sendobj, root=root, op=_op(op)))
+
+    def ibcast(self, obj: Any = None, root: int = 0) -> Request:
+        return Request(self._c.ibcast(obj, root=root))
+
+    def igather(self, sendobj: Any, root: int = 0) -> Request:
+        return Request(self._c.igather(sendobj, root=root))
+
+    def iallgather(self, sendobj: Any) -> Request:
+        return Request(self._c.iallgather(sendobj))
+
+    def iscatter(self, sendobj: Optional[List[Any]] = None,
+                 root: int = 0) -> Request:
+        return Request(self._c.iscatter(sendobj, root=root))
+
+    def ialltoall(self, sendobj: List[Any]) -> Request:
+        return Request(self._c.ialltoall(sendobj))
 
     # -- construction -------------------------------------------------------
 

@@ -772,3 +772,45 @@ class TestBufferCollectives:
             return err
 
         assert all(run_spmd(main, n=2))
+
+
+class TestNonblockingCollectives:
+    def test_iallreduce_ibcast_ibarrier_chain(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            # Launch three collectives before waiting any — they chain
+            # in launch order per the native contract.
+            r1 = comm.iallreduce(np.int64(r + 1))
+            r2 = comm.ibcast({"root": r} if r == 1 else None, root=1)
+            r3 = comm.ibarrier()
+            out = (int(r1.wait()), r2.wait(), r3.wait() is None)
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=3)
+        for total, bc, barrier_none in res:
+            assert total == 6
+            assert bc == {"root": 1}
+            assert barrier_none
+
+    def test_igather_iscatter_ialltoall(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            rg = comm.igather(f"g{r}", root=0)
+            rs = comm.iscatter([f"s{j}" for j in range(n)]
+                               if r == 0 else None, root=0)
+            ra = comm.ialltoall([f"{r}->{j}" for j in range(n)])
+            out = (rg.wait(), rs.wait(), ra.wait())
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=3)
+        for r, (g, s, a) in enumerate(res):
+            if r == 0:
+                assert g == ["g0", "g1", "g2"]
+            else:
+                assert g is None
+            assert s == f"s{r}"
+            assert a == [f"{j}->{r}" for j in range(3)]
