@@ -30,8 +30,11 @@ class TestBounce:
         # is only 10MB x 10 reps on loopback.
         res = _mpirun(2, "examples/bounce.py", "--json")
         assert res.returncode == 0, res.stderr
-        payload = json.loads(
-            [l for l in res.stdout.splitlines() if l.startswith("{")][0])
+        # raw_decode from the first brace: immune to another child's
+        # output landing on the same line (same interleaving class as
+        # the helloworld flake).
+        start = res.stdout.index('{')
+        payload = json.JSONDecoder().raw_decode(res.stdout[start:])[0]
         assert payload["sizes"][-1] == 10 ** 7
         assert len(payload["bytes_us"]) == len(payload["sizes"])
         assert all(v > 0 for v in payload["bytes_us"][1:])
