@@ -99,7 +99,8 @@ def _payload_count(obj: Any) -> int:
 
 
 class Request:
-    """Wraps the native request; mpi4py method names."""
+    """Wraps the native request; mpi4py method names (including the
+    classmethod set operations ``Waitall``/``Waitany``)."""
 
     def __init__(self, inner: "api.Request"):
         self._inner = inner
@@ -113,6 +114,28 @@ class Request:
         return self._inner.test()
 
     Test = test
+
+    @classmethod
+    def Waitall(cls, requests: List["Request"]) -> List[Any]:
+        """Wait on every request; results in order (mpi4py returns
+        statuses — here the payloads, which is what the lowercase
+        `waitall` idiom consumes)."""
+        return api.waitall([r._inner if r is not None else None
+                            for r in requests])
+
+    waitall = Waitall
+
+    @classmethod
+    def Waitany(cls, requests: List["Request"]):
+        """(index, result) of the first completion; the completed slot
+        is set to None in the caller's list (MPI_REQUEST_NULL), so a
+        drain loop visits each request once."""
+        inner = [r._inner if r is not None else None for r in requests]
+        idx, result = api.waitany(inner)
+        requests[idx] = None
+        return idx, result
+
+    waitany = Waitany
 
 
 class _AnySourceRequest(Request):

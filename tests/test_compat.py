@@ -814,3 +814,34 @@ class TestNonblockingCollectives:
                 assert g is None
             assert s == f"s{r}"
             assert a == [f"{j}->{r}" for j in range(3)]
+
+
+class TestRequestSets:
+    def test_waitall_and_waitany_drain(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            # every rank sends to every rank (incl. self) on its tag
+            sends = [comm.isend(f"{r}->{j}", dest=j, tag=100 + r)
+                     for j in range(n)]
+            recvs = [comm.irecv(source=j, tag=100 + j) for j in range(n)]
+            got = MPI.Request.Waitall(recvs)
+            MPI.Request.Waitall(sends)
+            # drain loop with Waitany over a fresh round
+            sends2 = [comm.isend(r * 10 + j, dest=j, tag=200 + r)
+                      for j in range(n)]
+            recvs2 = [comm.irecv(source=j, tag=200 + j)
+                      for j in range(n)]
+            drained = {}
+            for _ in range(n):
+                idx, val = MPI.Request.Waitany(recvs2)
+                drained[idx] = val
+            assert all(x is None for x in recvs2)  # REQUEST_NULL slots
+            MPI.Request.Waitall(sends2)
+            MPI.Finalize()
+            return got, drained
+
+        res = run_spmd(main, n=3)
+        for r, (got, drained) in enumerate(res):
+            assert got == [f"{j}->{r}" for j in range(3)]
+            assert drained == {j: j * 10 + r for j in range(3)}
