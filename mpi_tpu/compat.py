@@ -34,12 +34,27 @@ intercommunicators (``Create_intercomm``/``Merge`` + the
 :mod:`mpi_tpu.io`, :class:`mpi_tpu.comm.CartComm`,
 :mod:`mpi_tpu.distgraph`, and :mod:`mpi_tpu.intercomm` subsystems.
 
+Datatypes: the named basics (``MPI.DOUBLE``/``MPI.INT``/...) map onto
+numpy dtypes; buffer specs ``[buf, count, datatype]`` work on the
+element-wise uppercase ops — ``Send``/``Recv``/``Isend``/``Irecv``/
+``Sendrecv``, ``Bcast``, ``Allreduce``/``Reduce`` (send side of
+``Reduce_scatter`` too), and the send side of ``Allgather``/``Gather``
+— while the block-stacking sides (``Scatter``'s root table,
+``Alltoall``, gather-family receive tables) keep their bare-array
+leading-axis contract. The derived constructors ``Create_contiguous``
+/ ``Create_vector`` / ``Create_subarray`` (+ ``Commit``/``Free``/
+``Get_size``/``Get_extent``) pack strided layouts on the way out and
+scatter them back through the receive buffer. ``MPI.IN_PLACE`` works
+for Allreduce / Reduce / Allgather / Gather / Scatter, and the
+v-variants (``Gatherv``/``Scatterv``/``Allgatherv``/``Alltoallv``)
+take the ``[buf, counts, displs, datatype]`` spec.
+
 Scope honesty: this is the commonly-used core surface, not all of
-mpi4py (no derived datatypes beyond numpy dtypes, no dynamic process
-management, no passive-target RMA — windows are active-target
-fence-synchronized; window displacements are element offsets into the
-exposed array, so ``disp_unit`` is accepted only at its dtype-itemsize
-value). ``COMM_WORLD`` auto-initializes
+mpi4py (no ``Create_struct`` across mixed dtypes — one base dtype per
+datatype; no dynamic process management, no passive-target RMA —
+windows are active-target fence-synchronized; window displacements are
+element offsets into the exposed array, so ``disp_unit`` is accepted
+only at its dtype-itemsize value). ``COMM_WORLD`` auto-initializes
 the framework on first use, matching mpi4py's import-time init
 ergonomics; call ``MPI.Finalize()`` (or ``mpi_tpu.finalize()``) at the
 end as usual. No reference analogue (pure framework-usability work).
@@ -119,9 +134,12 @@ class Request:
     def Waitall(cls, requests: List["Request"]) -> List[Any]:
         """Wait on every request; results in order (mpi4py returns
         statuses — here the payloads, which is what the lowercase
-        `waitall` idiom consumes)."""
-        return api.waitall([r._inner if r is not None else None
-                            for r in requests])
+        `waitall` idiom consumes). Completion re-routes through each
+        wrapper's own ``wait`` (idempotent — the native request caches
+        its result) so buffer ``Irecv``s run their fill."""
+        api.waitall([r._inner if r is not None else None
+                     for r in requests])
+        return [r.wait() if r is not None else None for r in requests]
 
     waitall = Waitall
 
@@ -131,7 +149,8 @@ class Request:
         is set to None in the caller's list (MPI_REQUEST_NULL), so a
         drain loop visits each request once."""
         inner = [r._inner if r is not None else None for r in requests]
-        idx, result = api.waitany(inner)
+        idx, _ = api.waitany(inner)
+        result = requests[idx].wait()  # idempotent; runs Irecv fills
         requests[idx] = None
         return idx, result
 
@@ -150,6 +169,34 @@ class _AnySourceRequest(Request):
         return obj
 
     Wait = wait
+
+
+class _FillOnWaitRequest(Request):
+    """Uppercase ``Irecv``: completion must land the payload in the
+    caller's buffer (and run any datatype unpack), so ``wait`` routes
+    through a fill closure. ``Waitall``/``Waitany`` complete the inner
+    native request; the fill still runs exactly once, on first
+    observation, via the api.Request result cache — so this wrapper
+    fills eagerly inside the closure instead."""
+
+    def __init__(self, inner: "api.Request", wait_fill) -> None:
+        super().__init__(inner)
+        self._wait_fill = wait_fill
+        self._done = False
+
+    def wait(self, status: Optional[Status] = None) -> Any:
+        got = self._wait_fill(status)
+        self._done = True
+        return got
+
+    Wait = wait
+
+    def test(self) -> bool:
+        if not self._done and self._inner.test():
+            self.wait()
+        return self._done
+
+    Test = test
 
 
 class Comm:
@@ -288,21 +335,83 @@ class Comm:
         return None
 
     # -- buffer-based p2p (uppercase: numpy arrays, no repickling) ----------
+    #
+    # ``buf`` is a bare array or an mpi4py buffer spec ``[buf, count,
+    # datatype]`` (see the datatype section): derived datatypes pack on
+    # the way out and scatter back through the layout on the way in.
 
     def Send(self, buf: Any, dest: int, tag: int = 0) -> None:
-        self._c.send(np.ascontiguousarray(buf), dest, tag)
+        self._c.send(_spec_payload(buf, "Send"), dest, tag)
 
     def Recv(self, buf: Any, source: int = -1, tag: int = 0,
              status: Optional[Status] = None) -> None:
         _check_tag_not_wild(tag, "Recv")
-        _writable_buffer(buf, "Recv")  # validate before communicating
+        target = _RecvTarget(buf, "Recv")  # validate before communicating
         if source == ANY_SOURCE:
             src, got = self._c.receive_any(tag)
         else:
             src, got = source, self._c.receive(source, tag)
-        _fill(buf, got, "Recv")
+        target.fill(got)
         if status is not None:
             status.source, status.tag = src, tag
+            status.count = _payload_count(np.asarray(got))
+
+    def Isend(self, buf: Any, dest: int, tag: int = 0) -> Request:
+        return Request(self._c.isend(_spec_payload(buf, "Isend"),
+                                     dest, tag))
+
+    def Irecv(self, buf: Any, source: int = -1, tag: int = 0) -> Request:
+        """Nonblocking buffer receive: the buffer fills when the
+        returned request's ``wait()``/``Waitall`` completes."""
+        _check_tag_not_wild(tag, "Irecv")
+        target = _RecvTarget(buf, "Irecv")
+        if source == ANY_SOURCE:
+            inner = api.Request(lambda: self._c.receive_any(tag))
+
+            def _wait_fill_any(status: Optional[Status] = None) -> Any:
+                src, got = inner.wait()
+                target.fill(got)
+                if status is not None:
+                    status.source, status.tag = src, tag
+                    status.count = _payload_count(np.asarray(got))
+                return got
+        else:
+            inner = self._c.irecv(source, tag)
+
+            def _wait_fill_any(status: Optional[Status] = None) -> Any:
+                got = inner.wait()
+                target.fill(got)
+                if status is not None:
+                    status.source, status.tag = source, tag
+                    status.count = _payload_count(np.asarray(got))
+                return got
+        return _FillOnWaitRequest(inner, _wait_fill_any)
+
+    def Sendrecv(self, sendbuf: Any, dest: int, sendtag: int = 0,
+                 recvbuf: Any = None, source: int = -1,
+                 recvtag: Optional[int] = None,
+                 status: Optional[Status] = None) -> None:
+        """Buffer sendrecv (deadlock-free pairwise exchange); the
+        received payload lands in ``recvbuf``."""
+        if recvtag is None:
+            recvtag = sendtag
+        _check_tag_not_wild(recvtag, "Sendrecv")
+        target = _RecvTarget(recvbuf, "Sendrecv")
+        payload = _spec_payload(sendbuf, "Sendrecv")
+        if source == ANY_SOURCE:
+            sreq = self._c.isend(payload, dest, sendtag)
+            src, got = self._c.receive_any(recvtag)
+            sreq.wait()
+        elif sendtag == recvtag:
+            src, got = source, self._c.sendrecv(
+                payload, dest=dest, source=source, tag=sendtag)
+        else:
+            sreq = self._c.isend(payload, dest, sendtag)
+            src, got = source, self._c.receive(source, recvtag)
+            sreq.wait()
+        target.fill(got)
+        if status is not None:
+            status.source, status.tag = src, recvtag
             status.count = _payload_count(np.asarray(got))
 
     # -- collectives --------------------------------------------------------
@@ -316,21 +425,27 @@ class Comm:
         return self._c.bcast(obj, root=root)
 
     def Bcast(self, buf: Any, root: int = 0) -> None:
-        out = _writable_buffer(buf, "Bcast")
-        got = self._c.bcast(
-            np.ascontiguousarray(out) if self.Get_rank() == root else None,
-            root=root)
-        _fill(buf, got, "Bcast")
+        if self.Get_rank() == root:
+            # Root's buffer IS the data; nothing to write back.
+            self._c.bcast(_spec_payload(buf, "Bcast"), root=root)
+        else:
+            target = _RecvTarget(buf, "Bcast")
+            target.fill(self._c.bcast(None, root=root))
 
     def allreduce(self, sendobj: Any, op: "Op" = None) -> Any:
         return self._c.allreduce(sendobj, op=_op(op))
 
     def Allreduce(self, sendbuf: Any, recvbuf: Any,
                   op: "Op" = None) -> None:
-        _writable_buffer(recvbuf, "Allreduce")
-        got = self._c.allreduce(np.ascontiguousarray(sendbuf),
-                                op=_op(op))
-        _fill(recvbuf, got, "Allreduce")
+        """``sendbuf`` may be ``MPI.IN_PLACE``: this rank's
+        contribution is then read from ``recvbuf`` (mpi4py semantics);
+        either side may be a ``[buf, count, datatype]`` spec."""
+        target = _RecvTarget(recvbuf, "Allreduce")
+        if sendbuf is IN_PLACE:
+            payload = _spec_payload(recvbuf, "Allreduce")
+        else:
+            payload = _spec_payload(sendbuf, "Allreduce")
+        target.fill(self._c.allreduce(payload, op=_op(op)))
 
     def reduce(self, sendobj: Any, op: "Op" = None,
                root: int = 0) -> Optional[Any]:
@@ -338,32 +453,70 @@ class Comm:
 
     def Reduce(self, sendbuf: Any, recvbuf: Any, op: "Op" = None,
                root: int = 0) -> None:
-        got = self._c.reduce(np.ascontiguousarray(sendbuf), root=root,
-                             op=_op(op))
-        if self.Get_rank() == root:
-            _fill(recvbuf, got, "Reduce")
+        """At the root, ``sendbuf=MPI.IN_PLACE`` reads the root's
+        contribution from ``recvbuf`` (mpi4py semantics)."""
+        at_root = self.Get_rank() == root
+        target = _RecvTarget(recvbuf, "Reduce") if at_root else None
+        if sendbuf is IN_PLACE:
+            if not at_root:
+                raise api.MpiError(
+                    "mpi_tpu.compat: Reduce with MPI.IN_PLACE is only "
+                    "valid at the root (non-roots pass their sendbuf)")
+            payload = _spec_payload(recvbuf, "Reduce")
+        else:
+            payload = _spec_payload(sendbuf, "Reduce")
+        got = self._c.reduce(payload, root=root, op=_op(op))
+        if at_root:
+            target.fill(got)
 
     def Allgather(self, sendbuf: Any, recvbuf: Any) -> None:
         """Buffer allgather: ``recvbuf`` holds every rank's sendbuf
         stacked in rank order (shape ``(size, *sendbuf.shape)`` or any
-        same-size reshape of it)."""
-        got = self._c.allgather(np.ascontiguousarray(sendbuf))
+        same-size reshape of it). ``sendbuf=MPI.IN_PLACE`` reads this
+        rank's contribution from its slot of ``recvbuf``."""
+        if sendbuf is IN_PLACE:
+            out = _writable_buffer(recvbuf, "Allgather")
+            _leading_axis_is_size(out, self.Get_size(), "Allgather")
+            payload = np.ascontiguousarray(out[self.Get_rank()])
+        else:
+            payload = _spec_payload(sendbuf, "Allgather")
+        got = self._c.allgather(payload)
         _fill_stacked(recvbuf, got, "Allgather")
 
     def Gather(self, sendbuf: Any, recvbuf: Any, root: int = 0) -> None:
-        got = self._c.gather(np.ascontiguousarray(sendbuf), root=root)
+        """At the root, ``sendbuf=MPI.IN_PLACE`` reads the root's
+        contribution from its slot of ``recvbuf``."""
+        if sendbuf is IN_PLACE:
+            if self.Get_rank() != root:
+                raise api.MpiError(
+                    "mpi_tpu.compat: Gather with MPI.IN_PLACE is only "
+                    "valid at the root")
+            out = _writable_buffer(recvbuf, "Gather")
+            _leading_axis_is_size(out, self.Get_size(), "Gather")
+            payload = np.ascontiguousarray(out[root])
+        else:
+            payload = _spec_payload(sendbuf, "Gather")
+        got = self._c.gather(payload, root=root)
         if self.Get_rank() == root:
             _fill_stacked(recvbuf, got, "Gather")
 
     def Scatter(self, sendbuf: Any, recvbuf: Any, root: int = 0) -> None:
         """Buffer scatter: the root's ``sendbuf`` splits along its
-        leading axis (which must equal the comm size)."""
+        leading axis (which must equal the comm size). At the root,
+        ``recvbuf=MPI.IN_PLACE`` leaves the root's block in place."""
         if self.Get_rank() == root:
             arr = np.ascontiguousarray(sendbuf)
             _leading_axis_is_size(arr, self.Get_size(), "Scatter")
             parts: Optional[List[Any]] = list(arr)
         else:
             parts = None
+        if recvbuf is IN_PLACE:
+            if self.Get_rank() != root:
+                raise api.MpiError(
+                    "mpi_tpu.compat: Scatter with recvbuf=MPI.IN_PLACE "
+                    "is only valid at the root")
+            self._c.scatter(parts, root=root)
+            return
         got = self._c.scatter(parts, root=root)
         _fill(recvbuf, got, "Scatter")
 
@@ -385,9 +538,81 @@ class Comm:
             raise api.MpiError(
                 "mpi_tpu.compat: Reduce_scatter supports equal "
                 "recvcounts only (MPI_Reduce_scatter_block)")
-        got = self._c.reduce_scatter(np.ascontiguousarray(sendbuf),
-                                     op=_op(op))
+        got = self._c.reduce_scatter(
+            _spec_payload(sendbuf, "Reduce_scatter"), op=_op(op))
         _fill(recvbuf, got, "Reduce_scatter")
+
+    # -- v-variant collectives (per-rank counts + displacements) ------------
+    #
+    # MPI_Gatherv / Scatterv / Allgatherv / Alltoallv: the varying side
+    # takes a ``[buf, counts, displs(, datatype)]`` spec (displs=None
+    # means packed). Blocks travel as independent payloads over the
+    # object collectives — unequal sizes cost nothing here because the
+    # wire layer frames each payload anyway (unlike MPI's contiguous
+    # recvbuf contract, which this reassembles at the edges).
+
+    def Gatherv(self, sendbuf: Any, recvbuf: Any, root: int = 0) -> None:
+        payload = _spec_payload(sendbuf, "Gatherv")
+        parts = self._c.gather(payload, root=root)
+        if self.Get_rank() != root:
+            return
+        flat, counts, displs, _ = _parse_vspec(
+            recvbuf, self.Get_size(), "Gatherv")
+        for r, part in enumerate(parts):
+            data = np.asarray(part).reshape(-1)
+            if data.size != counts[r]:
+                raise api.MpiError(
+                    f"mpi_tpu.compat: Gatherv: rank {r} sent "
+                    f"{data.size} elements, recv counts[{r}] is "
+                    f"{counts[r]}")
+            flat[displs[r]:displs[r] + counts[r]] = data
+
+    def Scatterv(self, sendbuf: Any, recvbuf: Any, root: int = 0) -> None:
+        if self.Get_rank() == root:
+            flat, counts, displs, _ = _parse_vspec(
+                sendbuf, self.Get_size(), "Scatterv")
+            parts: Optional[List[Any]] = [
+                np.ascontiguousarray(flat[displs[r]:displs[r] + counts[r]])
+                for r in range(self.Get_size())]
+        else:
+            parts = None
+        got = self._c.scatter(parts, root=root)
+        target = _RecvTarget(recvbuf, "Scatterv")
+        target.fill(got)
+
+    def Allgatherv(self, sendbuf: Any, recvbuf: Any) -> None:
+        payload = _spec_payload(sendbuf, "Allgatherv")
+        parts = self._c.allgather(payload)
+        flat, counts, displs, _ = _parse_vspec(
+            recvbuf, self.Get_size(), "Allgatherv")
+        for r, part in enumerate(parts):
+            data = np.asarray(part).reshape(-1)
+            if data.size != counts[r]:
+                raise api.MpiError(
+                    f"mpi_tpu.compat: Allgatherv: rank {r} sent "
+                    f"{data.size} elements, recv counts[{r}] is "
+                    f"{counts[r]}")
+            flat[displs[r]:displs[r] + counts[r]] = data
+
+    def Alltoallv(self, sendbuf: Any, recvbuf: Any) -> None:
+        """Per-rank varying all-to-all: block j of the send spec goes
+        to rank j; block i of the recv spec fills from rank i."""
+        sflat, scounts, sdispls, _ = _parse_vspec(
+            sendbuf, self.Get_size(), "Alltoallv")
+        blocks = [np.ascontiguousarray(
+            sflat[sdispls[r]:sdispls[r] + scounts[r]])
+            for r in range(self.Get_size())]
+        parts = self._c.alltoall(blocks)
+        rflat, rcounts, rdispls, _ = _parse_vspec(
+            recvbuf, self.Get_size(), "Alltoallv")
+        for r, part in enumerate(parts):
+            data = np.asarray(part).reshape(-1)
+            if data.size != rcounts[r]:
+                raise api.MpiError(
+                    f"mpi_tpu.compat: Alltoallv: rank {r} sent "
+                    f"{data.size} elements, recv counts[{r}] is "
+                    f"{rcounts[r]}")
+            rflat[rdispls[r]:rdispls[r] + rcounts[r]] = data
 
     def gather(self, sendobj: Any, root: int = 0) -> Optional[List[Any]]:
         return self._c.gather(sendobj, root=root)
@@ -1084,6 +1309,481 @@ def _check_tag_not_wild(tag: int, what: str) -> None:
             f"receive-side tags default to 0, matching send's default")
 
 
+# -- datatypes -------------------------------------------------------------
+#
+# mpi4py's MPI.Datatype, re-expressed over numpy: a datatype is a base
+# numpy dtype plus an ELEMENT-OFFSET LAYOUT — the positions (in base
+# elements) one "item" of the type occupies inside its extent. Basic
+# types are the single-offset identity layout; the derived constructors
+# (Create_contiguous / Create_vector / Create_subarray) compose layouts
+# exactly the way MPI type maps compose. Packing a count of items
+# gathers ``count x len(offsets)`` elements into a contiguous wire
+# array; unpacking scatters them back through the caller's buffer —
+# which is how a strided column or an interior 2D block travels without
+# the caller copying it out first. No reference analogue (the reference
+# moves whole gob-encoded values, /root/reference/network.go:537-541);
+# this exists for mpi4py drop-in fidelity.
+
+class _InPlace:
+    """The MPI.IN_PLACE sentinel (identity compares, repr for errors)."""
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return "MPI.IN_PLACE"
+
+
+IN_PLACE = _InPlace()
+
+ORDER_C = 0
+ORDER_FORTRAN = ORDER_F = 1
+
+
+class Datatype:
+    """A numpy-backed MPI datatype. ``base`` is the element dtype;
+    ``offsets`` (int64 array, units of base elements) is the layout one
+    item occupies; ``extent`` (base elements) is the stride between
+    consecutive items. Basic named instances (``MPI.DOUBLE`` etc.) are
+    the identity layout and always committed; derived types must be
+    ``Commit()``-ed before use, as in MPI."""
+
+    def __init__(self, base: Any, offsets: Any = None,
+                 extent: Optional[int] = None, name: str = "",
+                 committed: bool = True):
+        self._base = np.dtype(base)
+        if offsets is None:
+            offsets = np.zeros(1, dtype=np.int64)
+        self._offsets = np.asarray(offsets, dtype=np.int64).reshape(-1)
+        if self._offsets.size == 0:
+            raise api.MpiError("mpi_tpu.compat: empty datatype layout")
+        self._extent_elems = int(self._extent_default()
+                                 if extent is None else extent)
+        self._name = name or self._base.name
+        self._committed = committed
+        self._predefined = False   # set True on the named module basics
+        self._freed = False
+        # Dense prefix layouts pack/unpack as one slice, no gather.
+        n = self._offsets.size
+        self._contig = bool(n == self._extent_elems
+                            and np.array_equal(self._offsets,
+                                               np.arange(n)))
+
+    def _extent_default(self) -> int:
+        return int(self._offsets.max()) + 1
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def dtype(self) -> np.dtype:
+        """The base numpy dtype (escape hatch for allocation)."""
+        return self._base
+
+    def Get_size(self) -> int:
+        """Bytes of DATA per item (holes excluded), MPI_Type_size."""
+        return int(self._offsets.size * self._base.itemsize)
+
+    size = property(Get_size)
+
+    def Get_extent(self):
+        """(lb, extent) in bytes, MPI_Type_get_extent (lb always 0)."""
+        return 0, int(self._extent_elems * self._base.itemsize)
+
+    @property
+    def extent(self) -> int:
+        return self.Get_extent()[1]
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"MPI.Datatype({self._name})"
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def Commit(self) -> "Datatype":
+        self._check_not_freed("Commit")
+        self._committed = True
+        return self
+
+    def Free(self) -> None:
+        if self._predefined:
+            # MPI forbids freeing predefined types; here it would also
+            # poison the shared module-level singleton for the process.
+            raise api.MpiError(
+                f"mpi_tpu.compat: cannot Free the predefined {self!r}")
+        self._freed = True
+
+    def _check_not_freed(self, what: str) -> None:
+        if self._freed:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what} on a freed {self!r}")
+
+    def _check_usable(self, what: str) -> None:
+        self._check_not_freed(what)
+        if not self._committed:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what} with uncommitted {self!r} — "
+                f"call .Commit() after deriving, as in MPI")
+
+    # -- derived constructors ----------------------------------------------
+
+    def _derive(self, item_positions: np.ndarray, extent_items: int,
+                name: str) -> "Datatype":
+        """Compose: place one copy of THIS layout at each position
+        (units of this type's extent) — the MPI type-map product."""
+        self._check_not_freed(name)
+        pos = np.asarray(item_positions, dtype=np.int64).reshape(-1)
+        offs = (pos[:, None] * self._extent_elems
+                + self._offsets[None, :]).reshape(-1)
+        return Datatype(self._base, offs,
+                        extent=extent_items * self._extent_elems,
+                        name=name, committed=False)
+
+    def Create_contiguous(self, count: int) -> "Datatype":
+        if count < 1:
+            raise api.MpiError(
+                f"mpi_tpu.compat: Create_contiguous count must be >= 1, "
+                f"got {count}")
+        return self._derive(np.arange(count), count,
+                            f"contiguous({count})x{self._name}")
+
+    def Create_vector(self, count: int, blocklength: int,
+                      stride: int) -> "Datatype":
+        if count < 1 or blocklength < 1 or stride < blocklength:
+            raise api.MpiError(
+                f"mpi_tpu.compat: Create_vector needs count,blocklength "
+                f">= 1 and stride >= blocklength, got ({count}, "
+                f"{blocklength}, {stride})")
+        pos = (np.arange(count)[:, None] * stride
+               + np.arange(blocklength)[None, :]).reshape(-1)
+        return self._derive(pos, (count - 1) * stride + blocklength,
+                            f"vector({count},{blocklength},{stride})"
+                            f"x{self._name}")
+
+    def Create_subarray(self, sizes, subsizes, starts,
+                        order: int = ORDER_C) -> "Datatype":
+        sizes = [int(s) for s in sizes]
+        subsizes = [int(s) for s in subsizes]
+        starts = [int(s) for s in starts]
+        if not (len(sizes) == len(subsizes) == len(starts)) or not sizes:
+            raise api.MpiError(
+                "mpi_tpu.compat: Create_subarray needs equal-length "
+                "non-empty sizes/subsizes/starts")
+        for d, (sz, sub, st) in enumerate(zip(sizes, subsizes, starts)):
+            if sub < 1 or st < 0 or st + sub > sz:
+                raise api.MpiError(
+                    f"mpi_tpu.compat: Create_subarray dim {d}: block "
+                    f"[{st}, {st + sub}) outside array of size {sz}")
+        np_order = "C" if order == ORDER_C else "F"
+        axes = np.meshgrid(*[st + np.arange(sub) for st, sub
+                             in zip(starts, subsizes)], indexing="ij")
+        pos = np.ravel_multi_index(
+            [a.reshape(-1) for a in axes], sizes, order=np_order)
+        # Pack order = ascending memory address of the full array, so
+        # the wire form reads as the block in storage order.
+        pos = np.sort(pos)
+        extent = 1
+        for s in sizes:
+            extent *= s
+        return self._derive(pos, extent,
+                            f"subarray({subsizes}@{starts} of {sizes})"
+                            f"x{self._name}")
+
+    # -- pack / unpack ------------------------------------------------------
+
+    def _flat(self, buf: Any, what: str, writable: bool) -> np.ndarray:
+        arr = buf if isinstance(buf, np.ndarray) else np.asarray(buf)
+        if writable:
+            _writable_buffer(arr if isinstance(buf, np.ndarray) else buf,
+                             what)
+        if arr.dtype != self._base:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what} buffer dtype {arr.dtype} does "
+                f"not match {self!r} (base {self._base}) — no silent "
+                f"byte reinterpretation here; view the buffer "
+                f"explicitly if that is intended")
+        if writable:
+            if not arr.flags.c_contiguous:
+                raise api.MpiError(
+                    f"mpi_tpu.compat: {what} needs a C-contiguous "
+                    f"receive buffer to write a datatype layout through "
+                    f"(got a strided view — express the stride in the "
+                    f"datatype instead)")
+            return arr.reshape(-1)
+        return np.ascontiguousarray(arr).reshape(-1)
+
+    def _span(self, count: int) -> int:
+        """Base elements the first ``count`` items touch."""
+        if count <= 0:
+            return 0
+        return (count - 1) * self._extent_elems + self._extent_default()
+
+    def _infer_count(self, flat_size: int, what: str) -> int:
+        span1 = self._extent_default()
+        if flat_size < span1:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: buffer of {flat_size} base "
+                f"elements cannot hold one {self!r} "
+                f"(needs {span1})")
+        return (flat_size - span1) // self._extent_elems + 1
+
+    def _check_count(self, flat: np.ndarray, count: int,
+                     what: str) -> None:
+        need = self._span(count)
+        if flat.size < need:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: count {count} of {self!r} "
+                f"spans {need} base elements; buffer has {flat.size}")
+
+    def _indices(self, count: int) -> np.ndarray:
+        return (np.arange(count)[:, None] * self._extent_elems
+                + self._offsets[None, :]).reshape(-1)
+
+    def _pack(self, buf: Any, count: Optional[int],
+              what: str) -> np.ndarray:
+        """``count`` items from ``buf`` as one contiguous base-dtype
+        array (the wire form). ``count=None`` packs as many as fit."""
+        self._check_usable(what)
+        flat = self._flat(buf, what, writable=False)
+        if count is None:
+            count = self._infer_count(flat.size, what)
+        self._check_count(flat, count, what)
+        if self._contig:
+            return np.ascontiguousarray(flat[:count * self._extent_elems])
+        return np.ascontiguousarray(flat[self._indices(count)])
+
+    def _unpack(self, buf: Any, got: Any, count: Optional[int],
+                what: str) -> None:
+        """Scatter a received contiguous array back through ``buf``'s
+        layout positions (count inferred from the payload if omitted)."""
+        self._check_usable(what)
+        flat = self._flat(buf, what, writable=True)
+        data = np.asarray(got).reshape(-1)
+        if data.dtype != self._base:
+            data = data.astype(self._base)
+        n = self._offsets.size
+        if count is None:
+            if data.size % n:
+                raise api.MpiError(
+                    f"mpi_tpu.compat: {what}: payload of {data.size} "
+                    f"elements is not a whole number of {self!r} items "
+                    f"({n} data elements each)")
+            count = data.size // n
+        elif data.size != count * n:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: payload has {data.size} "
+                f"elements, count {count} of {self!r} needs {count * n}")
+        self._check_count(flat, count, what)
+        if self._contig:
+            flat[:count * self._extent_elems] = data
+        else:
+            flat[self._indices(count)] = data
+
+
+# Named basic datatypes (the C-name set mpi4py exposes, mapped onto the
+# numpy dtypes the buffers actually carry).
+BYTE = Datatype(np.uint8, name="BYTE")
+CHAR = Datatype(np.int8, name="CHAR")
+SIGNED_CHAR = Datatype(np.int8, name="SIGNED_CHAR")
+UNSIGNED_CHAR = Datatype(np.uint8, name="UNSIGNED_CHAR")
+C_BOOL = BOOL = Datatype(np.bool_, name="BOOL")
+SHORT = Datatype(np.int16, name="SHORT")
+UNSIGNED_SHORT = Datatype(np.uint16, name="UNSIGNED_SHORT")
+INT = Datatype(np.int32, name="INT")
+UNSIGNED = UNSIGNED_INT = Datatype(np.uint32, name="UNSIGNED")
+LONG = Datatype(np.int64, name="LONG")
+UNSIGNED_LONG = Datatype(np.uint64, name="UNSIGNED_LONG")
+LONG_LONG = Datatype(np.int64, name="LONG_LONG")
+FLOAT = Datatype(np.float32, name="FLOAT")
+DOUBLE = Datatype(np.float64, name="DOUBLE")
+C_FLOAT_COMPLEX = COMPLEX = Datatype(np.complex64, name="COMPLEX")
+C_DOUBLE_COMPLEX = DOUBLE_COMPLEX = Datatype(np.complex128,
+                                             name="DOUBLE_COMPLEX")
+INT8_T = Datatype(np.int8, name="INT8_T")
+INT16_T = Datatype(np.int16, name="INT16_T")
+INT32_T = Datatype(np.int32, name="INT32_T")
+INT64_T = Datatype(np.int64, name="INT64_T")
+UINT8_T = Datatype(np.uint8, name="UINT8_T")
+UINT16_T = Datatype(np.uint16, name="UINT16_T")
+UINT32_T = Datatype(np.uint32, name="UINT32_T")
+UINT64_T = Datatype(np.uint64, name="UINT64_T")
+
+for _dt in list(globals().values()):
+    if isinstance(_dt, Datatype):
+        _dt._predefined = True
+del _dt
+
+
+# -- buffer-spec lists -----------------------------------------------------
+
+def _parse_spec(spec: Any, what: str):
+    """An mpi4py buffer spec — ``buf`` | ``[buf, datatype]`` |
+    ``[buf, count]`` | ``[buf, count, datatype]`` — as
+    ``(buf, count, datatype)`` with the absent parts None. Counts+
+    displacements lists belong to the v-variants (Gatherv etc.), which
+    parse with :func:`_parse_vspec`; passing one here raises with that
+    pointer."""
+    if not isinstance(spec, (list, tuple)):
+        return spec, None, None
+    if not spec:
+        raise api.MpiError(f"mpi_tpu.compat: {what}: empty buffer spec")
+    buf, count, dt = spec[0], None, None
+    if len(spec) > 3:
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what}: buffer spec has {len(spec)} "
+            f"entries; the [buf, counts, displs, datatype] form is the "
+            f"v-variant spec — use {what}v for per-rank counts")
+    for item in spec[1:]:
+        if isinstance(item, Datatype):
+            dt = item
+        elif isinstance(item, (int, np.integer)):
+            count = int(item)
+        elif item is None:
+            continue
+        else:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: unsupported buffer-spec entry "
+                f"{type(item).__name__} (per-rank count lists are the "
+                f"v-variant spec; use {what}v)")
+    return buf, count, dt
+
+
+def _spec_payload(spec: Any, what: str) -> np.ndarray:
+    """The contiguous wire array a send-side buffer spec denotes."""
+    buf, count, dt = _parse_spec(spec, what)
+    if buf is IN_PLACE:
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what}: MPI.IN_PLACE is only meaningful "
+            f"as the sendbuf of a reduction/gather family op")
+    if dt is not None:
+        return dt._pack(buf, count, what)
+    arr = np.ascontiguousarray(buf)
+    if count is not None:
+        if arr.size < count:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: count {count} exceeds buffer "
+                f"size {arr.size}")
+        arr = arr.reshape(-1)[:count]
+    return arr
+
+
+class _RecvTarget:
+    """A receive-side buffer spec, validated BEFORE the communication
+    happens (a bad buffer should fail before bytes move, not after),
+    then filled from the received payload."""
+
+    def __init__(self, spec: Any, what: str):
+        self.what = what
+        self.buf, self.count, self.dt = _parse_spec(spec, what)
+        if self.buf is IN_PLACE:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: MPI.IN_PLACE cannot be a "
+                f"plain receive buffer")
+        if self.dt is not None:
+            self.dt._check_usable(what)
+            flat = self.dt._flat(self.buf, what, writable=True)
+            if self.count is not None:
+                self.dt._check_count(flat, self.count, what)
+        else:
+            _writable_buffer(self.buf, what)
+            if self.count is not None:
+                if self.buf.size < self.count:
+                    raise api.MpiError(
+                        f"mpi_tpu.compat: {what}: count {self.count} "
+                        f"exceeds buffer size {self.buf.size}")
+                if not self.buf.flags.c_contiguous:
+                    # reshape(-1) on a strided view would be a COPY and
+                    # the received data would silently vanish — the
+                    # hazard _writable_buffer documents. Express the
+                    # stride as a datatype instead.
+                    raise api.MpiError(
+                        f"mpi_tpu.compat: {what}: a [buf, count] spec "
+                        f"needs a C-contiguous buffer (got a strided "
+                        f"view); describe the stride with a derived "
+                        f"datatype instead")
+
+    def fill(self, got: Any) -> None:
+        if self.dt is not None:
+            self.dt._unpack(self.buf, got, self.count, self.what)
+        elif self.count is not None:
+            flat = self.buf.reshape(-1)
+            data = np.asarray(got).reshape(-1)
+            if data.size != self.count:
+                raise api.MpiError(
+                    f"mpi_tpu.compat: {self.what}: payload has "
+                    f"{data.size} elements, spec count is {self.count}")
+            flat[:self.count] = data
+        else:
+            _fill(self.buf, got, self.what)
+
+
+def _parse_vspec(spec: Any, size: int, what: str):
+    """A v-variant spec — ``[buf, counts]`` | ``[buf, counts, displs]``
+    | ``[buf, counts, displs, datatype]`` (``displs`` may be None for
+    packed = cumulative) — as ``(flat_view, counts, displs, datatype)``
+    with bounds fully validated. The datatype must be basic (MPI allows
+    derived here; this shim scopes v-variants to element counts)."""
+    if not isinstance(spec, (list, tuple)) or len(spec) < 2:
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what} needs a [buf, counts(, displs"
+            f"(, datatype))] spec on the varying side")
+    if len(spec) > 4:
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what}: spec has {len(spec)} entries")
+    buf = spec[0]
+    counts = spec[1]
+    displs = spec[2] if len(spec) > 2 else None
+    dt = spec[3] if len(spec) > 3 else None
+    if isinstance(displs, Datatype):  # [buf, counts, datatype]
+        dt, displs = displs, None
+    if dt is not None:
+        if not isinstance(dt, Datatype):
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: last spec entry must be a "
+                f"Datatype, got {type(dt).__name__}")
+        dt._check_usable(what)
+        if dt._offsets.size != 1 or dt._extent_elems != 1:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: derived datatypes are not "
+                f"supported in v-variant specs (counts are element "
+                f"counts); pack with the datatype via {what[:-1]} "
+                f"instead")
+    counts = [int(c) for c in counts]
+    if len(counts) != size:
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what}: counts has {len(counts)} entries "
+            f"for a size-{size} communicator")
+    if any(c < 0 for c in counts):
+        raise api.MpiError(f"mpi_tpu.compat: {what}: negative count")
+    if displs is None:
+        displs = [0] * size
+        run = 0
+        for i, c in enumerate(counts):
+            displs[i] = run
+            run += c
+    else:
+        displs = [int(d) for d in displs]
+        if len(displs) != size:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: displs has {len(displs)} "
+                f"entries for a size-{size} communicator")
+    arr = buf if isinstance(buf, np.ndarray) else None
+    if arr is None:
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what} needs a numpy array buffer, got "
+            f"{type(buf).__name__}")
+    if dt is not None and arr.dtype != dt.dtype:
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what}: buffer dtype {arr.dtype} does "
+            f"not match {dt!r}")
+    if not arr.flags.c_contiguous:
+        raise api.MpiError(
+            f"mpi_tpu.compat: {what}: buffer must be C-contiguous")
+    flat = arr.reshape(-1)
+    for r in range(size):
+        if displs[r] < 0 or displs[r] + counts[r] > flat.size:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: rank {r} block "
+                f"[{displs[r]}, {displs[r] + counts[r]}) outside "
+                f"buffer of {flat.size} elements")
+    return flat, counts, displs, dt
+
+
 class _MPI:
     """The module-object stand-in mpi4py scripts address as ``MPI``."""
 
@@ -1092,6 +1792,39 @@ class _MPI:
     PROC_NULL = PROC_NULL
     ROOT = ROOT_SENTINEL
     UNDEFINED = UNDEFINED
+    IN_PLACE = IN_PLACE
+    ORDER_C = ORDER_C
+    ORDER_F = ORDER_F
+    ORDER_FORTRAN = ORDER_FORTRAN
+    Datatype = Datatype
+    BYTE = BYTE
+    CHAR = CHAR
+    SIGNED_CHAR = SIGNED_CHAR
+    UNSIGNED_CHAR = UNSIGNED_CHAR
+    BOOL = BOOL
+    C_BOOL = C_BOOL
+    SHORT = SHORT
+    UNSIGNED_SHORT = UNSIGNED_SHORT
+    INT = INT
+    UNSIGNED = UNSIGNED
+    UNSIGNED_INT = UNSIGNED_INT
+    LONG = LONG
+    UNSIGNED_LONG = UNSIGNED_LONG
+    LONG_LONG = LONG_LONG
+    FLOAT = FLOAT
+    DOUBLE = DOUBLE
+    COMPLEX = COMPLEX
+    C_FLOAT_COMPLEX = C_FLOAT_COMPLEX
+    DOUBLE_COMPLEX = DOUBLE_COMPLEX
+    C_DOUBLE_COMPLEX = C_DOUBLE_COMPLEX
+    INT8_T = INT8_T
+    INT16_T = INT16_T
+    INT32_T = INT32_T
+    INT64_T = INT64_T
+    UINT8_T = UINT8_T
+    UINT16_T = UINT16_T
+    UINT32_T = UINT32_T
+    UINT64_T = UINT64_T
     MODE_CREATE = MODE_CREATE
     MODE_RDONLY = MODE_RDONLY
     MODE_WRONLY = MODE_WRONLY

@@ -845,3 +845,287 @@ class TestRequestSets:
         for r, (got, drained) in enumerate(res):
             assert got == [f"{j}->{r}" for j in range(3)]
             assert drained == {j: j * 10 + r for j in range(3)}
+
+
+class TestDatatypes:
+    """MPI.Datatype: named basics, derived layouts, buffer specs,
+    IN_PLACE, and the v-variant collectives."""
+
+    def test_named_basics_size_and_dtype(self):
+        from mpi_tpu.compat import MPI
+
+        assert MPI.DOUBLE.Get_size() == 8
+        assert MPI.FLOAT.Get_size() == 4
+        assert MPI.INT.Get_size() == 4
+        assert MPI.BYTE.Get_size() == 1
+        assert MPI.DOUBLE.dtype == np.float64
+        assert MPI.INT64_T.dtype == np.int64
+        assert MPI.DOUBLE.Get_extent() == (0, 8)
+
+    def test_derived_size_extent_and_commit_rule(self):
+        from mpi_tpu.compat import MPI
+
+        vec = MPI.DOUBLE.Create_vector(3, 2, 4)
+        # 3 blocks of 2 doubles, stride 4: data 6 doubles, extent
+        # (2*4 + 2) = 10 doubles.
+        assert vec.Get_size() == 6 * 8
+        assert vec.Get_extent() == (0, 10 * 8)
+        with pytest.raises(api.MpiError, match="uncommitted"):
+            vec._pack(np.zeros(10), 1, "Send")
+        vec.Commit()
+        cont = MPI.INT.Create_contiguous(5).Commit()
+        assert cont.Get_size() == 20 and cont.extent == 20
+        vec.Free()
+        with pytest.raises(api.MpiError, match="freed"):
+            vec.Commit()
+
+    def test_vector_pack_unpack_roundtrip_local(self):
+        from mpi_tpu.compat import MPI
+
+        # Columns 0 and 1 of a 4x4 as one vector item each: count=4,
+        # blocklength=1, stride=4 over the flat array.
+        col = MPI.DOUBLE.Create_vector(4, 1, 4).Commit()
+        a = np.arange(16, dtype=np.float64).reshape(4, 4)
+        packed = col._pack(a, 1, "t")
+        np.testing.assert_array_equal(packed, a[:, 0])
+        out = np.zeros((4, 4))
+        col._unpack(out, packed, 1, "t")
+        np.testing.assert_array_equal(out[:, 0], a[:, 0])
+        assert out[:, 1:].sum() == 0
+
+    def test_subarray_block_pack(self):
+        from mpi_tpu.compat import MPI
+
+        sub = MPI.DOUBLE.Create_subarray(
+            (4, 5), (2, 3), (1, 1)).Commit()
+        a = np.arange(20, dtype=np.float64).reshape(4, 5)
+        packed = sub._pack(a, 1, "t")
+        np.testing.assert_array_equal(
+            packed, a[1:3, 1:4].reshape(-1))
+        out = np.zeros((4, 5))
+        sub._unpack(out, packed, 1, "t")
+        np.testing.assert_array_equal(out[1:3, 1:4], a[1:3, 1:4])
+        assert out.sum() == a[1:3, 1:4].sum()
+
+    def test_dtype_mismatch_raises(self):
+        from mpi_tpu.compat import MPI
+
+        with pytest.raises(api.MpiError, match="does not match"):
+            MPI.DOUBLE._pack(np.zeros(4, dtype=np.float32), 1, "Send")
+
+    def test_spec_send_recv_and_strided_column(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            col = MPI.DOUBLE.Create_vector(4, 1, 4).Commit()
+            if r == 0:
+                a = np.arange(16, dtype=np.float64).reshape(4, 4)
+                comm.Send([a, 1, col], dest=1, tag=1)      # column 0
+                comm.Send([a, 3, MPI.DOUBLE], dest=1, tag=2)
+                out = None
+            else:
+                b = np.zeros((4, 4))
+                comm.Recv([b, 1, col], source=0, tag=1)
+                head = np.zeros(8)
+                comm.Recv([head, 3, MPI.DOUBLE], source=0, tag=2)
+                out = b.copy(), head.copy()
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        b, head = res[1]
+        np.testing.assert_array_equal(b[:, 0], [0.0, 4.0, 8.0, 12.0])
+        assert b[:, 1:].sum() == 0
+        np.testing.assert_array_equal(head[:3], [0.0, 1.0, 2.0])
+        assert head[3:].sum() == 0
+
+    def test_bcast_subarray_spec(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            sub = MPI.DOUBLE.Create_subarray(
+                (3, 4), (2, 2), (0, 1)).Commit()
+            if r == 0:
+                a = np.arange(12, dtype=np.float64).reshape(3, 4)
+            else:
+                a = np.zeros((3, 4))
+            comm.Bcast([a, 1, sub], root=0)
+            MPI.Finalize()
+            return a
+
+        res = run_spmd(main, n=3)
+        want = np.arange(12, dtype=np.float64).reshape(3, 4)
+        for r, a in enumerate(res):
+            np.testing.assert_array_equal(a[0:2, 1:3], want[0:2, 1:3])
+            if r != 0:
+                assert a.sum() == want[0:2, 1:3].sum()
+
+    def test_in_place_allreduce_and_reduce(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            buf = np.full(3, float(r + 1))
+            comm.Allreduce(MPI.IN_PLACE, buf, op=MPI.SUM)
+            red = np.full(2, float(r + 1))
+            if r == 0:
+                comm.Reduce(MPI.IN_PLACE, red, op=MPI.SUM, root=0)
+            else:
+                comm.Reduce(red, None, op=MPI.SUM, root=0)
+            MPI.Finalize()
+            return buf, red
+
+        res = run_spmd(main, n=3)
+        total = 1.0 + 2.0 + 3.0
+        for r, (buf, red) in enumerate(res):
+            np.testing.assert_array_equal(buf, np.full(3, total))
+            if r == 0:
+                np.testing.assert_array_equal(red, np.full(2, total))
+
+    def test_in_place_allgather(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            table = np.zeros((n, 2))
+            table[r] = (r, 10.0 * r)
+            comm.Allgather(MPI.IN_PLACE, table)
+            MPI.Finalize()
+            return table
+
+        res = run_spmd(main, n=3)
+        want = np.asarray([[0.0, 0.0], [1.0, 10.0], [2.0, 20.0]])
+        for table in res:
+            np.testing.assert_array_equal(table, want)
+
+    def test_gatherv_scatterv_unequal_blocks(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            counts = [1, 2, 3][:n]
+            mine = np.full(counts[r], float(r), dtype=np.float64)
+            if r == 0:
+                gathered = np.zeros(sum(counts))
+                comm.Gatherv(mine, [gathered, counts, None, MPI.DOUBLE],
+                             root=0)
+            else:
+                gathered = None
+                comm.Gatherv(mine, None, root=0)
+            # Scatterv the same layout back out, with explicit displs.
+            displs = [0, 1, 3][:n]
+            if r == 0:
+                src = np.arange(6, dtype=np.float64)
+                back = np.empty(counts[r])
+                comm.Scatterv([src, counts, displs, MPI.DOUBLE], back,
+                              root=0)
+            else:
+                back = np.empty(counts[r])
+                comm.Scatterv(None, back, root=0)
+            MPI.Finalize()
+            return gathered, back
+
+        res = run_spmd(main, n=3)
+        g0 = res[0][0]
+        np.testing.assert_array_equal(
+            g0, [0.0, 1.0, 1.0, 2.0, 2.0, 2.0])
+        np.testing.assert_array_equal(res[0][1], [0.0])
+        np.testing.assert_array_equal(res[1][1], [1.0, 2.0])
+        np.testing.assert_array_equal(res[2][1], [3.0, 4.0, 5.0])
+
+    def test_allgatherv_and_alltoallv(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            counts = [2, 1, 3][:n]
+            mine = np.full(counts[r], float(r))
+            total = np.zeros(sum(counts))
+            comm.Allgatherv(mine, [total, counts])
+            # Alltoallv: rank r sends j copies of r to rank j... use
+            # scounts[j] = j + 1 elements to rank j, value 10*r + j.
+            scounts = [j + 1 for j in range(n)]
+            sdispls = np.concatenate(
+                ([0], np.cumsum(scounts)[:-1])).tolist()
+            sbuf = np.concatenate(
+                [np.full(j + 1, 10.0 * r + j) for j in range(n)])
+            rcounts = [r + 1] * n
+            rbuf = np.zeros(sum(rcounts))
+            comm.Alltoallv([sbuf, scounts, sdispls, MPI.DOUBLE],
+                           [rbuf, rcounts])
+            MPI.Finalize()
+            return total, rbuf
+
+        res = run_spmd(main, n=3)
+        want_total = np.asarray([0.0, 0.0, 1.0, 2.0, 2.0, 2.0])
+        for r, (total, rbuf) in enumerate(res):
+            np.testing.assert_array_equal(total, want_total)
+            want_r = np.concatenate(
+                [np.full(r + 1, 10.0 * src + r) for src in range(3)])
+            np.testing.assert_array_equal(rbuf, want_r)
+
+    def test_isend_irecv_buffer_fill_and_waitall(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            sends = [comm.Isend(np.full(2, float(r)), dest=j,
+                                tag=300 + r) for j in range(n)]
+            bufs = [np.zeros(2) for _ in range(n)]
+            recvs = [comm.Irecv(bufs[j], source=j, tag=300 + j)
+                     for j in range(n)]
+            MPI.Request.Waitall(recvs)
+            MPI.Request.Waitall(sends)
+            MPI.Finalize()
+            return bufs
+
+        res = run_spmd(main, n=3)
+        for bufs in res:
+            for j, b in enumerate(bufs):
+                np.testing.assert_array_equal(b, np.full(2, float(j)))
+
+    def test_sendrecv_uppercase_ring(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            right, left = (r + 1) % n, (r - 1) % n
+            out = np.full(2, float(r))
+            got = np.zeros(2)
+            st = MPI.Status()
+            comm.Sendrecv(out, dest=right, sendtag=5,
+                          recvbuf=got, source=left, recvtag=5,
+                          status=st)
+            MPI.Finalize()
+            return got, st.Get_source(), st.Get_count()
+
+        res = run_spmd(main, n=3)
+        for r, (got, src, cnt) in enumerate(res):
+            np.testing.assert_array_equal(
+                got, np.full(2, float((r - 1) % 3)))
+            assert src == (r - 1) % 3 and cnt == 2
+
+    def test_vspec_bounds_and_shape_validation(self):
+        from mpi_tpu.compat import (
+            MPI, _parse_vspec, _parse_spec)
+
+        buf = np.zeros(5)
+        with pytest.raises(api.MpiError, match="outside"):
+            _parse_vspec([buf, [3, 3], None], 2, "t")
+        with pytest.raises(api.MpiError, match="counts has"):
+            _parse_vspec([buf, [5]], 2, "t")
+        with pytest.raises(api.MpiError, match="v-variant"):
+            _parse_spec([buf, [1, 2], [0, 1], MPI.DOUBLE], "Gather")
+        with pytest.raises(api.MpiError, match="derived"):
+            vec = MPI.DOUBLE.Create_vector(2, 1, 2).Commit()
+            _parse_vspec([buf, [2, 3], None, vec], 2, "t")
+
+    def test_free_predefined_raises(self):
+        from mpi_tpu.compat import MPI
+
+        with pytest.raises(api.MpiError, match="predefined"):
+            MPI.DOUBLE.Free()
+        # ...and the singleton stays usable afterwards.
+        assert MPI.DOUBLE.Get_size() == 8
+        MPI.DOUBLE.Create_contiguous(2)
+
+    def test_count_spec_rejects_strided_recv_view(self):
+        from mpi_tpu.compat import MPI, _RecvTarget
+
+        b = np.zeros((4, 4))
+        with pytest.raises(api.MpiError, match="C-contiguous"):
+            _RecvTarget([b[:, :2], 8], "Recv")
