@@ -107,6 +107,24 @@ def train_flops_per_step(cfg, batch: int, seq: int) -> float:
     return 3.0 * fwd
 
 
+def _last_json(text: str):
+    """The LAST JSON object in a child's stdout, or None. raw_decode
+    from each brace-opening line: immune to another process's output
+    landing on the same line (the interleaving class behind the
+    helloworld flake — tests/test_examples.py uses the same defense)."""
+    dec = json.JSONDecoder()
+    found = None
+    for line in (text or "").splitlines():
+        start = line.find("{")
+        if start < 0:
+            continue
+        try:
+            found = dec.raw_decode(line[start:])[0]
+        except ValueError:
+            continue
+    return found
+
+
 def _median_time(fn, reps: int = 3):
     ts = []
     for _ in range(reps):
@@ -508,8 +526,10 @@ def measure_hybrid_allreduce() -> dict:
     if proc.returncode != 0:
         raise RuntimeError(f"hybrid allreduce child failed: "
                            f"{proc.stderr[-500:]}")
-    return json.loads(
-        [l for l in proc.stdout.splitlines() if l.startswith("{")][-1])
+    rec = _last_json(proc.stdout)
+    if rec is None:
+        raise RuntimeError("hybrid allreduce child printed no JSON")
+    return rec
 
 
 def _allreduce_child(sizes_csv: str) -> int:
@@ -664,8 +684,10 @@ def bounce_device(size: int = BOUNCE_SIZE) -> dict:
     if proc.returncode != 0:
         raise RuntimeError(f"device bounce child failed: "
                            f"{proc.stderr[-500:]}")
-    return json.loads(
-        [l for l in proc.stdout.splitlines() if l.startswith("{")][-1])
+    rec = _last_json(proc.stdout)
+    if rec is None:
+        raise RuntimeError("device bounce child printed no JSON")
+    return rec
 
 
 def _bounce_tcp_child() -> int:
@@ -737,10 +759,105 @@ def _allreduce_on_virtual_mesh(sizes) -> dict:
         capture_output=True, text=True, timeout=600)
     if proc.returncode != 0:
         raise RuntimeError(f"allreduce child failed: {proc.stderr[-500:]}")
-    rec = json.loads(
-        [l for l in proc.stdout.splitlines() if l.startswith("{")][-1])
+    rec = _last_json(proc.stdout)
+    if rec is None:
+        raise RuntimeError("allreduce child printed no JSON")
     return {f"{k}_cpu8mesh": v for k, v in rec.items()
             if k.endswith("_gbps") or k.endswith("_p50_us")}
+
+
+# Tiny-shape kwargs for --smoke / CPU-fallback runs (CI exercises the
+# full harness path in seconds; provenance keys mark the line).
+_SMOKE_TRAIN = dict(d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                    vocab=128, batch=2, seq=64, short=1, long=3)
+_SMOKE_LONGCTX = dict(seq=128, d_model=64, n_heads=4, n_layers=2,
+                      d_ff=128, vocab=128, short=1, long=3)
+_SMOKE_DECODE = dict(d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                     vocab=128, batch=2, prompt_len=16, short=4, long=12)
+
+
+def _device_leg_impl(name: str, smoke: bool) -> dict:
+    """One named device leg, run to completion in THIS process (the
+    ``--_device-leg`` child entry). Returns the leg's result keys."""
+    if name == "train":
+        return measure_train_step(**(_SMOKE_TRAIN if smoke else {}))
+    if name == "long_ctx":
+        return measure_long_context(**(_SMOKE_LONGCTX if smoke else {}))
+    if name == "decode":
+        return measure_decode(**(_SMOKE_DECODE if smoke else {}))
+    if name == "decode_int8":
+        return measure_decode(int8=True,
+                              **(_SMOKE_DECODE if smoke else {}))
+    if name == "allreduce":
+        ar_size = (1 << 20) if smoke else (256 << 20)
+        curve_sizes = [1 << 10, 32 << 10, 1 << 20]
+        if not smoke:
+            curve_sizes += [32 << 20, 256 << 20]
+        ar = measure_allreduce(ar_size)
+        if ar.get("allreduce_devices") == 1:
+            # Single chip: the in-process collective is the identity
+            # (keys are null); measure the real multi-device path on a
+            # virtual 8-device mesh instead — the full compact curve.
+            ar.update(_allreduce_on_virtual_mesh(curve_sizes))
+        else:
+            for s in curve_sizes:
+                if s != ar_size:
+                    ar.update(measure_allreduce(s))
+        return ar
+    raise ValueError(f"unknown device leg {name!r}")
+
+
+def _run_device_leg(name: str, timeout_s: float, smoke: bool,
+                    platform: Optional[str]) -> dict:
+    """Run one device leg in a SUBPROCESS with its own deadline.
+
+    Why a subprocess: the tunnel can drop AFTER a successful preflight
+    (observed in round 3: preflight OK, UNAVAILABLE 20 minutes later),
+    and a jax call stuck on a dead device blocks in C — uninterruptible
+    from Python. Isolating each leg means a hang costs one leg's
+    budget, not every remaining measurement. The persistent
+    JAX_COMPILATION_CACHE_DIR (set in main) keeps per-process
+    recompiles cheap."""
+    import signal
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--_device-leg", name]
+    if smoke:
+        cmd.append("--smoke")
+    if platform:
+        cmd += ["--platform", platform]
+    # start_new_session: the leg child may spawn its own children (the
+    # allreduce leg's virtual-mesh subprocess); a timeout must kill the
+    # whole process GROUP or an orphaned grandchild keeps saturating
+    # the CPU under the later host-side timing legs.
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:  # raced its own exit
+            pass
+        out, err = proc.communicate()
+        lines = (err or "").strip().splitlines()
+        tail = lines[-1][:200] if lines else ""
+        return {f"{name}_error":
+                f"leg timed out after {timeout_s:.0f}s (device/tunnel "
+                f"hang); killed. last stderr: {tail}"}
+    if err:
+        sys.stderr.write(err)  # leg logs flow into the round log
+    if proc.returncode != 0:
+        lines = (err or "").strip().splitlines()
+        return {f"{name}_error":
+                f"leg child rc={proc.returncode}: "
+                f"{lines[-1][:250] if lines else 'no stderr'}"}
+    rec = _last_json(out)
+    if rec is None:
+        return {f"{name}_error": "leg child printed no JSON"}
+    return rec
 
 
 def _device_preflight(timeout_s: float = 300.0):
@@ -809,13 +926,15 @@ def main() -> int:
         return _hybrid_allreduce_child()
     # --platform cpu[:N] pins the JAX platform before any device query;
     # the driver runs with no flag and gets the real chip.
+    platform_arg: Optional[str] = None
     if "--platform" in sys.argv:
         idx = sys.argv.index("--platform")
         if idx + 1 >= len(sys.argv):
             print("usage: bench.py [--platform NAME[:NUM_DEVICES]]"
                   " [--suite]", file=sys.stderr)
             return 2
-        name, _, count = sys.argv[idx + 1].partition(":")
+        platform_arg = sys.argv[idx + 1]
+        name, _, count = platform_arg.partition(":")
         from mpi_tpu.utils.platform import force_platform
 
         if not force_platform(name, int(count) if count else None):
@@ -826,6 +945,13 @@ def main() -> int:
     # --smoke: tiny shapes so CI can exercise the full harness path on
     # CPU in seconds; the real run uses the defaults on the real chip.
     smoke = "--smoke" in sys.argv
+
+    if "--_device-leg" in sys.argv:
+        # Child entry for one isolated device leg (after --platform so
+        # the parent can pin the child's platform explicitly).
+        idx = sys.argv.index("--_device-leg")
+        print(json.dumps(_device_leg_impl(sys.argv[idx + 1], smoke)))
+        return 0
 
     deadline = float(os.environ.get("MPI_TPU_BENCH_DEADLINE_S", "2400"))
 
@@ -888,8 +1014,16 @@ def main() -> int:
     smoke = smoke or bool(tpu_fallback)
 
     watchdog = _install_watchdog(deadline) if deadline > 0 else None
+    deadline_end = time.monotonic() + deadline if deadline > 0 else None
 
-    # TCP bounce first: subprocesses, no device contention with the rest.
+    # Subprocess legs (device legs + virtual-mesh allreduce) share one
+    # persistent compilation cache, so per-process isolation doesn't
+    # pay per-process compiles.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+
     # Every leg runs under _leg(): a completed leg lands in _PARTIALS
     # immediately (the watchdog's error line carries whatever finished
     # before a hang), and a FAILED leg — e.g. the TPU tunnel dropping
@@ -899,6 +1033,7 @@ def main() -> int:
     result: dict = {}
 
     def _leg(label, fn):
+        t0 = time.monotonic()
         try:
             r = fn()
         except BaseException as exc:  # noqa: BLE001 - line must appear
@@ -907,6 +1042,10 @@ def main() -> int:
             r = {f"{label}_error":
                  f"{type(exc).__name__}: {str(exc)[:300]}"}
             print(f"bench: {label} leg failed: {exc}", file=sys.stderr)
+        # Leg-by-leg wall clock on stderr: when a run blows the
+        # watchdog, the log shows exactly where the time went.
+        print(f"bench: leg {label} finished in "
+              f"{time.monotonic() - t0:.1f}s", file=sys.stderr)
         result.update(r)
         _PARTIALS.update(r)
         return r
@@ -936,54 +1075,55 @@ def main() -> int:
             keys["bounce_xla_error"] = str(exc)[:200]
         return keys
 
+    # Headline first: if anything later blows the watchdog, the
+    # partial line must already carry the MFU (round-2 lesson: the
+    # bounce legs ran first and a late hang would have left the
+    # flagship number unmeasured). Each device leg runs in its own
+    # subprocess with its own deadline (see _run_device_leg) and never
+    # outlives the remaining watchdog budget — the one-line contract
+    # holds even if every leg hangs. The allreduce leg carries the
+    # BASELINE config-3 compact curve (1 KiB → 256 MiB; smoke caps at
+    # 1 MiB) in the DEFAULT line — the driver never passes --suite.
+    leg_platform = platform_arg or ("cpu:1" if tpu_fallback else None)
+    budgets = {"train": 900.0, "long_ctx": 700.0, "decode": 420.0,
+               "decode_int8": 420.0, "allreduce": 700.0}
+    if smoke:
+        budgets = {k: min(v, 200.0) for k, v in budgets.items()}
+    for leg_name in ("train", "long_ctx", "decode", "decode_int8",
+                     "allreduce"):
+        if deadline_end is not None:
+            remaining = deadline_end - time.monotonic() - 120.0
+            if remaining < 45.0:
+                rec = {f"{leg_name}_error":
+                       "skipped: watchdog budget exhausted"}
+                result.update(rec)
+                _PARTIALS.update(rec)
+                print(f"bench: leg {leg_name} skipped (watchdog budget "
+                      f"exhausted)", file=sys.stderr)
+                continue
+            budget = min(budgets[leg_name], remaining)
+        else:
+            budget = budgets[leg_name]
+        _leg(leg_name, lambda n=leg_name, b=budget:
+             _run_device_leg(n, b, smoke, leg_platform))
+
+    # Host-side legs: the parent never touches the real accelerator
+    # (every device measurement above is a subprocess — a tunnel drop
+    # here would wedge the parent past the watchdog), so pin it to
+    # CPU before anything below can lazily initialize a backend. The
+    # provenance key marks the change: bounce_xla/bounce_device now
+    # always measure the host-side rendezvous on the virtual CPU mesh,
+    # where BENCH_r01/r02 ran them on whatever backend the parent held.
+    from mpi_tpu.utils.platform import force_platform
+
+    if platform_arg is None and not tpu_fallback:
+        force_platform("cpu", 8)
+        rec = {"host_legs_platform": "cpu:8"}
+        result.update(rec)
+        _PARTIALS.update(rec)
     _leg("bounce", bounce_legs)
     _leg("bounce_device",
          lambda: bounce_device((1 << 14) if smoke else BOUNCE_SIZE))
-    ar_size = (1 << 20) if smoke else (256 << 20)
-    if smoke:
-        _leg("train", lambda: measure_train_step(
-            d_model=64, n_layers=2, n_heads=4, d_ff=128, vocab=128,
-            batch=2, seq=64, short=1, long=3))
-        _leg("long_ctx", lambda: measure_long_context(
-            seq=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
-            vocab=128, short=1, long=3))
-        _leg("decode", lambda: measure_decode(
-            d_model=64, n_layers=2, n_heads=4, d_ff=128, vocab=128,
-            batch=2, prompt_len=16, short=4, long=12))
-        _leg("decode_int8", lambda: measure_decode(
-            d_model=64, n_layers=2, n_heads=4, d_ff=128, vocab=128,
-            batch=2, prompt_len=16, short=4, long=12, int8=True))
-    else:
-        _leg("train", measure_train_step)
-        _leg("long_ctx", measure_long_context)
-        _leg("decode", measure_decode)
-        _leg("decode_int8", lambda: measure_decode(int8=True))
-
-    # BASELINE config-3 compact curve, in the DEFAULT line (the driver
-    # never passes --suite): 1 KiB -> 256 MiB in x32 steps. On real
-    # multi-chip hardware the curve comes from measure_allreduce per
-    # size; on the 1-chip/CPU box it runs on the virtual 8-device mesh.
-    # Smoke/fallback runs cap at 1 MiB: the big points on a single-core
-    # CPU cost minutes each, exactly what the smoke degradation is
-    # protecting the watchdog deadline from.
-    curve_sizes = [1 << 10, 32 << 10, 1 << 20]
-    if not smoke:
-        curve_sizes += [32 << 20, 256 << 20]
-
-    def allreduce_legs():
-        ar = measure_allreduce(ar_size)
-        if ar.get("allreduce_devices") == 1:
-            # Single chip: the in-process collective is the identity
-            # (keys are null); measure the real multi-device path on a
-            # virtual 8-device mesh instead — the full compact curve.
-            ar.update(_allreduce_on_virtual_mesh(curve_sizes))
-        else:
-            for s in curve_sizes:
-                if s != ar_size:
-                    ar.update(measure_allreduce(s))
-        return ar
-
-    _leg("allreduce", allreduce_legs)
     # BASELINE config 5: the hierarchical two-tier engine at 32 ranks
     # (4 hosts x 8 locals), in the default line.
     _leg("hybrid_allreduce", measure_hybrid_allreduce)

@@ -158,9 +158,11 @@ def _block_probs(q_ref, k_ref, lse_ref, qi, ki, *, causal: bool,
                  scale: float, block_q: int, block_k: int, seq_k: int):
     """Backward-pass helper: rebuild this block's softmax probabilities
     from (q, k, lse) — the FlashAttention-2 trick that replaces O(s²)
-    stored residuals. Returns (q, k, p) in float32."""
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
+    stored residuals. Returns (q, k) in their stored dtype (bf16 dots
+    run the MXU at full rate; f32 casts would quarter it) and p in
+    float32 (the exp must match the forward's f32 softmax state)."""
+    q = q_ref[0]
+    k = k_ref[0]
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -187,9 +189,14 @@ def _flash_kernel_fwd_res(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)       # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)       # (block_k, d)
-        v = v_ref[0].astype(jnp.float32)
+        # Inputs stay in their STORED dtype (bf16 on the flagship) so
+        # the MXU runs at full bf16 rate; preferred_element_type keeps
+        # the accumulation f32 — softmax state is always f32. Casting
+        # to f32 first would quarter the matmul throughput on v5e for
+        # identical accumulator precision.
+        q = q_ref[0]                           # (block_q, d)
+        k = k_ref[0]                           # (block_k, d)
+        v = v_ref[0]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -202,7 +209,7 @@ def _flash_kernel_fwd_res(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=-1)
         m_scr[:, 0] = m_new
         acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -235,8 +242,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def compute():
-        v = v_ref[0].astype(jnp.float32)
-        g = g_ref[0].astype(jnp.float32)
+        v = v_ref[0]
+        g = g_ref[0]
         _, k, p = _block_probs(q_ref, k_ref, lse_ref, qi, ki,
                                causal=causal, scale=scale, block_q=block_q,
                                block_k=block_k, seq_k=seq_k)
@@ -245,7 +252,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, 0][:, None]) * scale
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -280,20 +287,20 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def compute():
-        v = v_ref[0].astype(jnp.float32)
-        g = g_ref[0].astype(jnp.float32)
+        v = v_ref[0]
+        g = g_ref[0]
         q, _, p = _block_probs(q_ref, k_ref, lse_ref, qi, ki,
                                causal=causal, scale=scale, block_q=block_q,
                                block_k=block_k, seq_k=seq_k)
         dv_scr[:] += jax.lax.dot_general(
-            p, g, (((0,), (0,)), ((), ())),
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             g, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, 0][:, None]) * scale
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:

@@ -74,9 +74,12 @@ def _decode_kernel(n_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     # correct skip semantics).
     @pl.when(ki * block_k <= n_valid)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32)        # (rows, d)
-        k = k_ref[0, :, 0].astype(jnp.float32)     # (block_k, d)
-        v = v_ref[0, :, 0].astype(jnp.float32)
+        # Stored dtype in, f32 accumulation out: bf16 dots run the MXU
+        # at full rate (an f32 upcast first would quarter throughput
+        # for the same f32 accumulator); softmax state stays f32.
+        q = q_ref[0, 0]                            # (rows, d)
+        k = k_ref[0, :, 0]                         # (block_k, d)
+        v = v_ref[0, :, 0]
         logits = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -91,7 +94,7 @@ def _decode_kernel(n_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=-1)
         m_scr[:, 0] = m_new
         acc_scr[:] = acc_scr[:] * corr[:, None] + lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
