@@ -842,6 +842,8 @@ def _run_device_leg(name: str, timeout_s: float, smoke: bool,
         except ProcessLookupError:  # raced its own exit
             pass
         out, err = proc.communicate()
+        if err:
+            sys.stderr.write(err)  # full traceback into the round log
         lines = (err or "").strip().splitlines()
         tail = lines[-1][:200] if lines else ""
         return {f"{name}_error":

@@ -284,9 +284,11 @@ class _MeshCollectives:
                 # x: (1, L, *shape); each rank keeps its reduced L/n block.
                 y = x[0]
                 if deterministic:
-                    # Binomial-tree order → bitwise parity with the
-                    # generic driver's reduce-then-slice.
-                    total = C.tree_allreduce(y, "rank", op=op)
+                    # Canonical (size-selected ring/tree) order →
+                    # bitwise parity with the generic driver's
+                    # reduce-then-slice at every payload size.
+                    total = C.allreduce(y, "rank", op=op,
+                                        deterministic=True)
                     shard = y.shape[0] // lax.axis_size("rank")
                     idx = lax.axis_index("rank")
                     out = lax.dynamic_slice_in_dim(total, idx * shard,
@@ -378,11 +380,12 @@ class _MeshCollectives:
                 # Oversubscribed ranks share devices → no mesh; user
                 # callable ops (MPI_Op_create analogue) are host
                 # functions XLA cannot compile. Either way reduce on
-                # the host in the canonical binomial-tree order (always
-                # deterministic, bitwise-equal to the TCP oracle).
-                from ..collectives_generic import tree_combine
+                # the host in the canonical order — ring or tree by the
+                # shared size rule (always deterministic, bitwise-equal
+                # to the TCP oracle on both sides of the threshold).
+                from ..collectives_generic import canonical_combine
 
-                total = tree_combine(np_slots, op)
+                total = canonical_combine(np_slots, op)
                 per = [total.copy() for _ in range(self._n)]
             else:
                 garr = self._global_array(np_slots)
@@ -544,7 +547,7 @@ class _MeshCollectives:
         ``deterministic``) over the mesh."""
         det = (self.deterministic_collectives if deterministic is None
                else deterministic)
-        from ..collectives_generic import check_op, tree_combine
+        from ..collectives_generic import canonical_combine, check_op
 
         check_op(op)
 
@@ -559,7 +562,7 @@ class _MeshCollectives:
                     f"equal blocks")
             m = shape[0] // self._n
             if self._mesh is None or callable(op):
-                total = tree_combine(np_slots, op)
+                total = canonical_combine(np_slots, op)
                 return [total[i * m:(i + 1) * m].copy()
                         for i in range(self._n)]
             garr = self._global_array(np_slots)

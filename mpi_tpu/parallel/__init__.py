@@ -15,6 +15,7 @@ from .collectives import (
     hierarchical_allreduce,
     pshift,
     reduce_scatter,
+    ring_allreduce,
     tree_allreduce,
 )
 from .ring_attention import (
@@ -71,5 +72,6 @@ __all__ = [
     "hierarchical_allreduce",
     "pshift",
     "reduce_scatter",
+    "ring_allreduce",
     "tree_allreduce",
 ]

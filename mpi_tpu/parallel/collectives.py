@@ -30,6 +30,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .mesh import RANK_AXIS
@@ -69,8 +70,15 @@ def allreduce(x: jnp.ndarray, axis_name: str = RANK_AXIS, op: str = "sum",
     Fast path: XLA-native (ring) collectives. ``prod`` has no native XLA
     collective, so it gathers and reduces in rank order (deterministic by
     construction). ``deterministic=True`` routes through
-    :func:`tree_allreduce` for bitwise parity with the TCP driver."""
+    :func:`tree_allreduce` — or :func:`ring_allreduce` for large
+    payloads, applying the generic layer's ``ring_eligible`` rule
+    verbatim — for bitwise parity with the TCP driver at every size."""
     if deterministic:
+        from ..collectives_generic import ring_eligible
+
+        if ring_eligible(x.size * np.dtype(x.dtype).itemsize,
+                         x.dtype, lax.axis_size(axis_name), op):
+            return ring_allreduce(x, axis_name, op)
         return tree_allreduce(x, axis_name, op)
     if op == "sum":
         return lax.psum(x, axis_name)
@@ -123,6 +131,51 @@ def tree_allreduce(x: jnp.ndarray, axis_name: str = RANK_AXIS,
         is_receiver = idx % (2 * d) == d
         x = jnp.where(is_receiver, received, x)
     return x
+
+
+def ring_allreduce(x: jnp.ndarray, axis_name: str = RANK_AXIS,
+                   op: str = "sum") -> jnp.ndarray:
+    """Ring reduce-scatter + ring allgather in compiled ``ppermute``
+    neighbor hops — the bandwidth-optimal algorithm (2(n-1)/n of the
+    buffer per rank), and the canonical RING combination order:
+    block ``b`` folds rank contributions left-to-right starting at
+    rank ``b``, exactly replaying
+    ``collectives_generic.ring_allreduce`` so the two are
+    bitwise-identical (the large-payload half of the cross-driver
+    contract; ``ring_eligible`` decides the switch on both sides).
+    On TPU every hop is one ICI neighbor transfer — this is the
+    textbook ring allreduce the hardware's torus is built for."""
+    if op not in OPS:
+        raise ValueError(
+            f"mpi_tpu: unknown reduction op {op!r}; expected {OPS}")
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    idx = lax.axis_index(axis_name)
+    shape, size = x.shape, x.size
+    m = -(-size // n)  # ceil: pad so n equal blocks tile the buffer
+    flat = x.reshape(-1)
+    if n * m != size:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((n * m - size,), x.dtype)])
+    blocks = flat.reshape(n, m)
+    to_right = [(r, (r + 1) % n) for r in range(n)]
+    # Reduce-scatter: after round t this rank holds the running partial
+    # for block (idx - t - 1) % n, covering ranks b..idx in ring order.
+    carry = lax.dynamic_index_in_dim(blocks, idx, 0, keepdims=False)
+    for t in range(n - 1):
+        incoming = lax.ppermute(carry, axis_name, to_right)
+        mine = lax.dynamic_index_in_dim(blocks, (idx - t - 1) % n, 0,
+                                        keepdims=False)
+        carry = _combine(incoming, mine, op)
+    # Allgather: rotate the completed blocks the rest of the way round.
+    out = jnp.zeros((n, m), carry.dtype)
+    out = lax.dynamic_update_index_in_dim(out, carry, (idx + 1) % n, 0)
+    cur = carry
+    for u in range(n - 1):
+        cur = lax.ppermute(cur, axis_name, to_right)
+        out = lax.dynamic_update_index_in_dim(out, cur, (idx - u) % n, 0)
+    return out.reshape(-1)[:size].reshape(shape)
 
 
 def hierarchical_allreduce(x: jnp.ndarray, inner_axis: str = "inner",
