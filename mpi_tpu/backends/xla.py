@@ -283,18 +283,11 @@ class _MeshCollectives:
             def per_shard(x):
                 # x: (1, L, *shape); each rank keeps its reduced L/n block.
                 y = x[0]
-                if deterministic:
-                    # Canonical (size-selected ring/tree) order →
-                    # bitwise parity with the generic driver's
-                    # reduce-then-slice at every payload size.
-                    total = C.allreduce(y, "rank", op=op,
-                                        deterministic=True)
-                    shard = y.shape[0] // lax.axis_size("rank")
-                    idx = lax.axis_index("rank")
-                    out = lax.dynamic_slice_in_dim(total, idx * shard,
-                                                   shard, axis=0)
-                else:
-                    out = C.reduce_scatter(y, "rank", op=op)
+                # deterministic → canonical size-selected order; the
+                # ring/tree choice lives in parallel.collectives next
+                # to allreduce's so the rule can never fork.
+                out = C.reduce_scatter(y, "rank", op=op,
+                                       deterministic=deterministic)
                 return out[None]
 
             out_specs = P("rank")

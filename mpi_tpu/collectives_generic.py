@@ -41,6 +41,7 @@ __all__ = [
     "reduce",
     "allreduce",
     "ring_allreduce",
+    "ring_reduce_scatter",
     "ring_combine",
     "canonical_combine",
     "ring_eligible",
@@ -289,15 +290,7 @@ def ring_allreduce(impl: Interface, data: Any, op: OpLike = "sum") -> Any:
     m = -(-flat.size // n)  # ceil: pad so n equal blocks tile the buffer
     padded = np.zeros(n * m, dtype=arr.dtype)
     padded[:flat.size] = flat
-    blocks = padded.reshape(n, m)
-    # Reduce-scatter: after round t this rank holds the running partial
-    # for block (me - t - 1) % n, covering ranks b..me in ring order.
-    carry = blocks[me].copy()
-    for t in range(n - 1):
-        incoming = np.asarray(
-            _sendrecv(impl, carry, right, left, tag + t))
-        b = (me - t - 1) % n
-        carry = np.asarray(combine(incoming, blocks[b], op))
+    carry = _ring_fold_phase(impl, padded.reshape(n, m), op, tag)
     # Allgather: rotate the completed blocks the rest of the way round.
     out = np.empty((n, m), dtype=carry.dtype)
     out[(me + 1) % n] = carry
@@ -307,6 +300,26 @@ def ring_allreduce(impl: Interface, data: Any, op: OpLike = "sum") -> Any:
             _sendrecv(impl, cur, right, left, tag + (n - 1) + u))
         out[(me - u) % n] = cur
     return out.reshape(-1)[:flat.size].reshape(arr.shape)
+
+
+def _ring_fold_phase(impl: Interface, blocks: np.ndarray, op: OpLike,
+                     tag: int) -> np.ndarray:
+    """The n-1 fold rounds of the canonical ring order — THE single
+    wire-side definition (ring_allreduce and ring_reduce_scatter both
+    run it; ring_combine and the parallel module replay it). After
+    round t this rank holds the running partial for block
+    ``(me - t - 1) % n``, covering ranks b..me in ring order; the
+    return value is the completed block ``(me + 1) % n``. Uses tags
+    ``tag .. tag + n - 2``."""
+    n, me = impl.size(), impl.rank()
+    right, left = (me + 1) % n, (me - 1) % n
+    carry = blocks[me].copy()
+    for t in range(n - 1):
+        incoming = np.asarray(
+            _sendrecv(impl, carry, right, left, tag + t))
+        carry = np.asarray(combine(incoming, blocks[(me - t - 1) % n],
+                                   op))
+    return carry
 
 
 def canonical_combine(slots: List[Any], op: OpLike) -> np.ndarray:
@@ -349,9 +362,12 @@ def ring_combine(slots: List[Any], op: OpLike) -> np.ndarray:
 def reduce_scatter(impl: Interface, data: Any, op: OpLike = "sum") -> Any:
     """Reduce across ranks, then keep this rank's block: the payload's
     leading axis splits into ``size`` equal blocks and rank ``i`` returns
-    reduced block ``i``. Combination order is the canonical binomial tree
-    (reduce-then-slice), so results are bitwise-identical to the XLA
-    driver's deterministic path."""
+    reduced block ``i``. Combination order is the canonical
+    size-selected order (:func:`allreduce`): binomial tree
+    reduce-then-slice below the ring threshold; above it, the DIRECT
+    ring reduce-scatter phase — bitwise-identical to ring-allreduce-
+    then-slice (the block split and per-block fold coincide exactly
+    when the leading axis divides) while moving half the data."""
     check_op(op)
     arr = np.asarray(data)
     n = impl.size()
@@ -360,10 +376,42 @@ def reduce_scatter(impl: Interface, data: Any, op: OpLike = "sum") -> Any:
             f"mpi_tpu: reduce_scatter payload leading axis "
             f"{arr.shape if arr.ndim else 'scalar'} must divide into {n} "
             f"equal blocks")
+    if ring_eligible(arr.nbytes, arr.dtype, n, op):
+        return ring_reduce_scatter(impl, arr, op=op)
     total = np.asarray(allreduce(impl, data, op=op))
     m = arr.shape[0] // n
     me = impl.rank()
     return total[me * m:(me + 1) * m]
+
+
+def ring_reduce_scatter(impl: Interface, data: Any,
+                        op: OpLike = "sum") -> Any:
+    """The reduce-scatter PHASE of :func:`ring_allreduce` plus one
+    block rotation: after n-1 fold rounds rank ``r`` holds reduced
+    block ``(r+1) % n`` in the canonical ring order; one neighbor hop
+    lands block ``r`` at rank ``r``. Moves ``n/(n-1) ≈ 1`` buffer per
+    rank versus the full ring allreduce's 2 — and stays bitwise-equal
+    to allreduce-then-slice because the fold order per block is the
+    same (``parallel.collectives.ring_reduce_scatter`` replays this
+    with ppermute for the XLA deterministic path)."""
+    check_op(op)
+    arr = np.asarray(data)
+    n, me = impl.size(), impl.rank()
+    if arr.ndim < 1 or arr.shape[0] % n:
+        raise MpiError(
+            f"mpi_tpu: reduce_scatter payload leading axis "
+            f"{arr.shape if arr.ndim else 'scalar'} must divide into {n} "
+            f"equal blocks")
+    if n == 1:
+        return arr.copy()
+    k = arr.shape[0] // n
+    tag = _next_tag_base(impl)
+    right, left = (me + 1) % n, (me - 1) % n
+    # leading-axis blocks == flat blocks (divisible, so no padding)
+    carry = _ring_fold_phase(impl, arr.reshape(n, -1), op, tag)
+    # Rotation: my left neighbor finished block me; swap along the ring.
+    mine = np.asarray(_sendrecv(impl, carry, right, left, tag + n - 1))
+    return mine.reshape((k,) + arr.shape[1:])
 
 
 def gather(impl: Interface, data: Any, root: int = 0) -> Optional[List[Any]]:

@@ -16,6 +16,7 @@ from .collectives import (
     pshift,
     reduce_scatter,
     ring_allreduce,
+    ring_reduce_scatter,
     tree_allreduce,
 )
 from .ring_attention import (
@@ -73,5 +74,6 @@ __all__ = [
     "pshift",
     "reduce_scatter",
     "ring_allreduce",
+    "ring_reduce_scatter",
     "tree_allreduce",
 ]

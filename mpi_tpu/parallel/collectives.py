@@ -158,17 +158,9 @@ def ring_allreduce(x: jnp.ndarray, axis_name: str = RANK_AXIS,
     if n * m != size:
         flat = jnp.concatenate(
             [flat, jnp.zeros((n * m - size,), x.dtype)])
-    blocks = flat.reshape(n, m)
-    to_right = [(r, (r + 1) % n) for r in range(n)]
-    # Reduce-scatter: after round t this rank holds the running partial
-    # for block (idx - t - 1) % n, covering ranks b..idx in ring order.
-    carry = lax.dynamic_index_in_dim(blocks, idx, 0, keepdims=False)
-    for t in range(n - 1):
-        incoming = lax.ppermute(carry, axis_name, to_right)
-        mine = lax.dynamic_index_in_dim(blocks, (idx - t - 1) % n, 0,
-                                        keepdims=False)
-        carry = _combine(incoming, mine, op)
+    carry = _ring_fold_phase(flat.reshape(n, m), axis_name, op)
     # Allgather: rotate the completed blocks the rest of the way round.
+    to_right = [(r, (r + 1) % n) for r in range(n)]
     out = jnp.zeros((n, m), carry.dtype)
     out = lax.dynamic_update_index_in_dim(out, carry, (idx + 1) % n, 0)
     cur = carry
@@ -176,6 +168,50 @@ def ring_allreduce(x: jnp.ndarray, axis_name: str = RANK_AXIS,
         cur = lax.ppermute(cur, axis_name, to_right)
         out = lax.dynamic_update_index_in_dim(out, cur, (idx - u) % n, 0)
     return out.reshape(-1)[:size].reshape(shape)
+
+
+def _ring_fold_phase(blocks: jnp.ndarray, axis_name: str,
+                     op: str) -> jnp.ndarray:
+    """The n-1 ppermute fold rounds of the canonical ring order — the
+    single compiled-side definition (ring_allreduce and
+    ring_reduce_scatter share it; it replays
+    ``collectives_generic._ring_fold_phase`` bit for bit). After round
+    t this rank holds the partial for block ``(idx - t - 1) % n``; the
+    return value is the completed block ``(idx + 1) % n``."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    to_right = [(r, (r + 1) % n) for r in range(n)]
+    carry = lax.dynamic_index_in_dim(blocks, idx, 0, keepdims=False)
+    for t in range(n - 1):
+        incoming = lax.ppermute(carry, axis_name, to_right)
+        mine = lax.dynamic_index_in_dim(blocks, (idx - t - 1) % n, 0,
+                                        keepdims=False)
+        carry = _combine(incoming, mine, op)
+    return carry
+
+
+def ring_reduce_scatter(x: jnp.ndarray, axis_name: str = RANK_AXIS,
+                        op: str = "sum") -> jnp.ndarray:
+    """The reduce-scatter phase of :func:`ring_allreduce` plus one
+    rotation hop: this rank returns reduced block ``idx`` of ``x``'s
+    leading axis (which must divide by the axis size). Bitwise-equal to
+    ``collectives_generic.ring_reduce_scatter`` and to ring-allreduce-
+    then-slice, at half the ring allreduce's data movement."""
+    if op not in OPS:
+        raise ValueError(
+            f"mpi_tpu: unknown reduction op {op!r}; expected {OPS}")
+    n = lax.axis_size(axis_name)
+    if x.ndim < 1 or x.shape[0] % n:
+        raise ValueError(
+            f"mpi_tpu: ring_reduce_scatter leading axis {x.shape} must "
+            f"divide into {n} equal blocks")
+    if n == 1:
+        return x
+    k = x.shape[0] // n
+    carry = _ring_fold_phase(x.reshape(n, -1), axis_name, op)
+    to_right = [(r, (r + 1) % n) for r in range(n)]
+    mine_final = lax.ppermute(carry, axis_name, to_right)
+    return mine_final.reshape((k,) + x.shape[1:])
 
 
 def hierarchical_allreduce(x: jnp.ndarray, inner_axis: str = "inner",
@@ -207,11 +243,35 @@ def hierarchical_allreduce(x: jnp.ndarray, inner_axis: str = "inner",
 
 def reduce_scatter(x: jnp.ndarray, axis_name: str = RANK_AXIS,
                    op: str = "sum", scatter_dimension: int = 0,
-                   tiled: bool = True) -> jnp.ndarray:
+                   tiled: bool = True,
+                   deterministic: bool = False) -> jnp.ndarray:
     """Reduce across the axis and leave each rank with its shard —
     the building block of bandwidth-optimal ring allreduce
     (reduce_scatter + allgather), exposed directly because model code
-    (e.g. ZeRO-style optimizers) wants the scattered form."""
+    (e.g. ZeRO-style optimizers) wants the scattered form.
+
+    ``deterministic=True`` produces the canonical size-selected order
+    (the cross-driver bitwise contract, same rule as
+    :func:`allreduce`): the direct ring phase above the
+    ``ring_eligible`` threshold, binomial-tree reduce-then-slice below
+    it. The selection lives HERE, next to allreduce's, so the rule can
+    never fork between drivers."""
+    if deterministic:
+        if scatter_dimension != 0 or not tiled:
+            raise ValueError(
+                "mpi_tpu: deterministic reduce_scatter supports "
+                "scatter_dimension=0, tiled=True (the driver contract)")
+        from ..collectives_generic import ring_eligible
+
+        n = lax.axis_size(axis_name)
+        if ring_eligible(x.size * np.dtype(x.dtype).itemsize,
+                         x.dtype, n, op):
+            return ring_reduce_scatter(x, axis_name, op)
+        total = allreduce(x, axis_name, op, deterministic=True)
+        idx = lax.axis_index(axis_name)
+        shard = x.shape[0] // n
+        return lax.dynamic_slice_in_dim(total, idx * shard, shard,
+                                        axis=0)
     if op != "sum":
         gathered = lax.all_gather(x, axis_name, axis=0)  # (n, ...)
         acc = gathered[0]
