@@ -68,6 +68,11 @@ USER_TAG_SPAN = 1 << 40   # user tags within a region: [0, 2^40)
 # user tag can alias a halo message) and fenced off from the generic
 # collectives' growing sequence by _map_tag's exhaustion check.
 _NEIGHBOR_SLICE = 1 << 20
+# RMA window passive-target service traffic owns the slice directly
+# below the neighborhood slice (two tags per window: requests +
+# replies; see window._svc_tags). Same fencing rule: the generic
+# collective sequence is capped below both slices.
+_WIN_SLICE = 1 << 20
 # Context numbering: negotiated contexts grow monotonically from 1 and
 # can never plausibly reach the top of the space, so the topmost
 # _CREATE_GROUP_TAGS contexts are reserved as create_group's bootstrap
@@ -392,11 +397,12 @@ class Comm:
     def _coll_seq(self, value: int) -> None:
         from .collectives_generic import _TAGS_PER_COLLECTIVE
 
-        # Cap the generic sequence below the neighborhood slice at the
-        # top of the collective offset space: allocation-time exhaustion
-        # beats a silently mis-routed halo tag ~4e9 collectives later.
-        limit = (CTX_SPAN - USER_TAG_SPAN - _NEIGHBOR_SLICE) \
-            // _TAGS_PER_COLLECTIVE
+        # Cap the generic sequence below the neighborhood + window
+        # slices at the top of the collective offset space: allocation-
+        # time exhaustion beats a silently mis-routed halo or RMA
+        # service tag ~4e9 collectives later.
+        limit = (CTX_SPAN - USER_TAG_SPAN - _NEIGHBOR_SLICE
+                 - _WIN_SLICE) // _TAGS_PER_COLLECTIVE
         if value >= limit:
             raise MpiError(
                 "mpi_tpu: communicator collective tag space exhausted")

@@ -51,10 +51,12 @@ take the ``[buf, counts, displs, datatype]`` spec.
 
 Scope honesty: this is the commonly-used core surface, not all of
 mpi4py (no ``Create_struct`` across mixed dtypes — one base dtype per
-datatype; no dynamic process management, no passive-target RMA —
-windows are active-target fence-synchronized; window displacements are
-element offsets into the exposed array, so ``disp_unit`` is accepted
-only at its dtype-itemsize value). ``COMM_WORLD`` auto-initializes
+datatype; no dynamic process management; passive-target RMA
+(``Win.Lock``/``Unlock``/``Flush``) needs the window created with
+``info={"locks": "true"}`` — see :meth:`Win.Create`; window
+displacements are element offsets into the exposed array, so
+``disp_unit`` is accepted only at its dtype-itemsize value).
+``COMM_WORLD`` auto-initializes
 the framework on first use, matching mpi4py's import-time init
 ergonomics; call ``MPI.Finalize()`` (or ``mpi_tpu.finalize()``) at the
 end as usual. No reference analogue (pure framework-usability work).
@@ -1023,7 +1025,11 @@ class Win:
                comm: Optional[Comm] = None) -> "Win":
         """Collective window creation (``MPI_Win_create``). ``memory``
         is this rank's exposed 1-D numpy array; ``comm`` defaults to
-        ``COMM_WORLD`` (there is no COMM_SELF here)."""
+        ``COMM_WORLD`` (there is no COMM_SELF here). Passive-target
+        ``Lock``/``Unlock`` needs ``info={"locks": "true"}`` (every
+        member must pass it — it starts the per-rank service thread;
+        the inverse of MPI's ``no_locks`` hint, off by default because
+        the software progress engine polls)."""
         from .window import win_create
 
         # np.asarray on a list would expose a detached COPY: remote
@@ -1034,8 +1040,10 @@ class Win:
                 f"mpi_tpu.compat: Win displacements are element offsets "
                 f"of dtype {mem.dtype}; disp_unit={disp_unit} conflicts "
                 f"with itemsize {mem.dtype.itemsize}")
+        locks = bool(info) and str(
+            dict(info).get("locks", "false")).lower() == "true"
         c = (MPI.COMM_WORLD if comm is None else comm)._c
-        return cls(win_create(c, mem))
+        return cls(win_create(c, mem, locks=locks))
 
     @property
     def native(self):
@@ -1068,11 +1076,19 @@ class Win:
         arr = np.asarray(origin)
         self._w.put(arr, target_rank, self._disp(target, arr.size))
 
+    def _deliver(self, h: Any, out: np.ndarray) -> None:
+        """Passive (lock-epoch) results are ready immediately — land
+        them now; fence-epoch results wait for the closing Fence."""
+        if h.ready:
+            np.copyto(out, h.array.reshape(out.shape))
+        else:
+            self._pending.append((h, out))
+
     def Get(self, origin: Any, target_rank: int, target=None) -> None:
         out = _writable_buffer(origin, "Win.Get")
         h = self._w.get(target_rank, self._disp(target, out.size),
                         count=out.size)
-        self._pending.append((h, out))
+        self._deliver(h, out)
 
     def Accumulate(self, origin: Any, target_rank: int, target=None,
                    op: Optional[Op] = None) -> None:
@@ -1087,14 +1103,14 @@ class Win:
         h = self._w.get_accumulate(arr, target_rank,
                                    self._disp(target, arr.size),
                                    op=_op(op))
-        self._pending.append((h, out))
+        self._deliver(h, out)
 
     def Fetch_and_op(self, origin: Any, result: Any, target_rank: int,
                      target=0, op: Optional[Op] = None) -> None:
         out = _writable_buffer(result, "Win.Fetch_and_op")
         h = self._w.fetch_and_op(np.asarray(origin), target_rank,
                                  self._disp(target, 1), op=_op(op))
-        self._pending.append((h, out))
+        self._deliver(h, out)
 
     def Fence(self, assertion: int = 0) -> None:
         """Close the epoch (collective): all queued RMA completes, and
@@ -1104,6 +1120,30 @@ class Win:
         pending, self._pending = self._pending, []
         for handle, out in pending:
             np.copyto(out, handle.array.reshape(out.shape))
+
+    # -- passive target (MPI_Win_lock/unlock) -------------------------------
+
+    def Lock(self, rank: int, lock_type: Optional[int] = None,
+             assertion: int = 0) -> None:
+        """Open a passive epoch at ``rank`` (needs the window created
+        with ``info={"locks": "true"}``). ``lock_type`` defaults to
+        ``MPI.LOCK_EXCLUSIVE``, as in mpi4py."""
+        self._w.lock(rank, exclusive=(lock_type != LOCK_SHARED))
+
+    def Unlock(self, rank: int) -> None:
+        self._w.unlock(rank)
+
+    def Lock_all(self, assertion: int = 0) -> None:
+        self._w.lock_all()
+
+    def Unlock_all(self) -> None:
+        self._w.unlock_all()
+
+    def Flush(self, rank: int) -> None:
+        self._w.flush(rank)
+
+    def Flush_all(self) -> None:
+        self._w.flush_all()
 
     def Shared_query(self, rank: int):
         """(buffer, disp_unit) — a direct reference to ``rank``'s
@@ -1259,6 +1299,10 @@ MODE_UNIQUE_OPEN = 32
 MODE_EXCL = 64
 MODE_APPEND = 128
 MODE_SEQUENTIAL = 256
+
+# MPI_Win_lock types (mpi4py exposes the same names).
+LOCK_EXCLUSIVE = 234
+LOCK_SHARED = 235
 
 
 def _writable_buffer(buf: Any, what: str) -> np.ndarray:
@@ -1834,6 +1878,8 @@ class _MPI:
     MODE_EXCL = MODE_EXCL
     MODE_APPEND = MODE_APPEND
     MODE_SEQUENTIAL = MODE_SEQUENTIAL
+    LOCK_EXCLUSIVE = LOCK_EXCLUSIVE
+    LOCK_SHARED = LOCK_SHARED
     SUM = Op("sum")
     PROD = Op("prod")
     MIN = Op("min")

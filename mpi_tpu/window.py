@@ -1,4 +1,4 @@
-"""One-sided communication — RMA windows (MPI_Win, active target).
+"""One-sided communication — RMA windows (MPI_Win).
 
 The last MPI pillar the facade lacked: every rank exposes a local array
 (the *window*), and peers read/write it with :meth:`Window.put` /
@@ -8,8 +8,21 @@ target issuing a matching call. Synchronization is **active-target fence
 epochs** (MPI_Win_fence): RMA calls issued between two fences are
 queued locally and complete collectively at the closing fence —
 exactly MPI's "all operations complete at the fence" contract.
-(Passive-target lock/unlock is intentionally not provided; fences are
-the model the collective transports realize faithfully.)
+
+**Passive target** (MPI_Win_lock/unlock) is available on windows
+created with ``win_create(..., locks=True)``: each rank then runs a
+window *service thread* that serves lock requests and applies RMA
+operations the moment they arrive — true one-sided progress without
+the target calling anything (the software progress engine every
+socket-transport MPI uses). Inside a lock epoch, put/get/accumulate/
+get_accumulate/fetch_and_op execute synchronously at the target (so
+``flush`` is a completed-by-construction ordering point), exclusive
+locks serialize read-modify-write sequences, and shared locks admit
+concurrent readers; waiters queue strictly FIFO (consecutive shared
+requests grant as a batch). ``locks`` defaults to False because the
+service thread polls the driver's ANY_SOURCE probe — the same
+latency/CPU tradeoff MPI implementations expose inverted via the
+``no_locks`` info hint.
 
 tpu-first realization: a fence is two ``alltoall`` rounds over the
 window's communicator — one delivering queued put/accumulate records,
@@ -29,15 +42,45 @@ so every rank computes the same window contents from the same ops.
 from __future__ import annotations
 
 import threading
-from typing import Any, List, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .api import MpiError
-from .collectives_generic import OpLike, combine
-from .comm import Comm
+from .collectives_generic import COLL_TAG_BASE, OpLike, combine
+from .comm import CTX_SPAN, USER_TAG_SPAN, Comm
+from .comm import _NEIGHBOR_SLICE, _WIN_SLICE
 
 __all__ = ["Window", "win_create"]
+
+_win_alloc_lock = threading.Lock()
+
+
+def _svc_tags(comm: Comm, wid: int) -> Tuple[int, int]:
+    """(request, reply) tags for window ``wid``'s passive-target
+    service, carved from the reserved window slice directly below the
+    neighborhood slice (comm.py tag layout)."""
+    if wid * 2 + 1 >= _WIN_SLICE:
+        raise MpiError(
+            f"mpi_tpu: window id space exhausted (wid={wid})")
+    base = COLL_TAG_BASE + (CTX_SPAN - USER_TAG_SPAN - _NEIGHBOR_SLICE
+                            - _WIN_SLICE) + wid * 2
+    return base, base + 1
+
+
+def _alloc_wid(comm: Comm) -> int:
+    """Collectively-consistent window id: window creation is collective
+    on ``comm``, so a per-``(rank, context)`` counter on the driver
+    yields the same id on every member (keyed like the comm's
+    _CollState — by rank too, because thread-per-rank drivers share one
+    impl object)."""
+    key = (comm._impl.rank(), comm.context)
+    with _win_alloc_lock:
+        seqs = comm._impl.__dict__.setdefault("_win_seqs", {})
+        wid = seqs.get(key, 0)
+        seqs[key] = wid + 1
+    return wid
 
 
 class RmaHandle:
@@ -50,6 +93,12 @@ class RmaHandle:
     def __init__(self) -> None:
         self._value: Optional[np.ndarray] = None
         self._ready = False
+
+    @property
+    def ready(self) -> bool:
+        """True once the result is defined — immediately for passive
+        (lock-epoch) operations, at the closing fence otherwise."""
+        return self._ready
 
     @property
     def array(self) -> np.ndarray:
@@ -68,10 +117,18 @@ class Window:
     through put/get/accumulate and completes at the closing fence.
     """
 
-    def __init__(self, comm: Comm, local: np.ndarray):
+    def __init__(self, comm: Comm, local: np.ndarray,
+                 locks: bool = False):
         self._comm = comm
         self._local = local
         self._lock = threading.Lock()
+        # Passive target (set up at the end of __init__, after the
+        # collective metadata round, so every member's service thread
+        # starts only on fully-constructed windows).
+        self._locks_enabled = bool(locks)
+        self._held: Dict[int, str] = {}      # target -> "excl"/"shared"
+        self._origin_lock = threading.Lock()  # serialize my requests
+        self._svc_thread: Optional[threading.Thread] = None
         # (target, offset, payload, op, fetch_handle): op None = put;
         # a non-None handle makes it a get_accumulate (pre-value read).
         self._puts: List[Tuple[int, int, np.ndarray, Optional[OpLike],
@@ -108,6 +165,18 @@ class Window:
             self._shared: Optional[List[np.ndarray]] = entries
         else:
             self._shared = None
+        if self._locks_enabled:
+            wid = _alloc_wid(comm)
+            self._svc_tag, self._reply_tag = _svc_tags(comm, wid)
+            # Lock state lives on (and is only touched by) the service
+            # thread — no extra synchronization needed.
+            self._lk_excl: Optional[int] = None
+            self._lk_shared: set = set()
+            self._lk_waiters: deque = deque()
+            self._svc_thread = threading.Thread(
+                target=self._serve, daemon=True,
+                name=f"mpi-win-svc-{wid}")
+            self._svc_thread.start()
 
     # -- identity ----------------------------------------------------------
 
@@ -166,6 +235,18 @@ class Window:
         validate the span, queue the record for the closing fence."""
         arr = np.array(data, dtype=self._local.dtype, copy=True).reshape(-1)
         self._check_span(target, offset, arr.shape[0])
+        if target in self._held:
+            # Passive epoch: execute synchronously at the target's
+            # service thread (completed on return; flush is trivially
+            # satisfied). The pre-value rides the reply for
+            # get_accumulate/fetch_and_op.
+            pre = self._svc_request(
+                target, ("apply", int(offset), arr, op,
+                         handle is not None))
+            if handle is not None:
+                handle._value = np.asarray(pre)
+                handle._ready = True
+            return
         with self._lock:
             self._puts.append((target, int(offset), arr, op, handle))
 
@@ -220,9 +301,197 @@ class Window:
             count = self._extents[target] - offset
         self._check_span(target, offset, count)
         handle = RmaHandle()
+        if target in self._held:
+            handle._value = np.asarray(
+                self._svc_request(target, ("get", int(offset),
+                                           int(count))))
+            handle._ready = True
+            return handle
         with self._lock:
             self._gets.append((target, int(offset), int(count), handle))
         return handle
+
+    # -- passive target (lock/unlock epochs) -------------------------------
+
+    def _require_locks(self, what: str) -> None:
+        if not self._locks_enabled:
+            raise MpiError(
+                f"mpi_tpu: Window.{what} needs a passive-target window "
+                f"— create it with win_create(comm, local, locks=True) "
+                f"(runs a per-rank service thread; see module doc)")
+
+    def _svc_request(self, target: int, msg: Tuple) -> Any:
+        """One request/reply round-trip to ``target``'s service thread.
+        Serialized per window (the reply tag is a single slot); a lock
+        request may legitimately block here until the current holder
+        unlocks."""
+        with self._origin_lock:
+            self._comm.send(msg, target, self._svc_tag)
+            kind, payload = self._comm.receive(target, self._reply_tag)
+        if kind == "err":
+            raise MpiError(payload)
+        return payload
+
+    def lock(self, target: int, exclusive: bool = True) -> None:
+        """Open a passive-target epoch at ``target`` (MPI_Win_lock):
+        blocks until the lock is granted. ``exclusive=False`` is
+        MPI_LOCK_SHARED (concurrent holders allowed); waiters are
+        served strictly FIFO with consecutive shared requests granted
+        as a batch. RMA issued before :meth:`unlock` executes
+        synchronously at the target."""
+        self._require_locks("lock")
+        self._comm._check_peer(target)
+        if target in self._held:
+            raise MpiError(
+                f"mpi_tpu: Window.lock({target}) while already holding "
+                f"a lock on that rank")
+        with self._lock:
+            if self._puts or self._gets:
+                raise MpiError(
+                    "mpi_tpu: Window.lock with un-fenced active-target "
+                    "RMA pending — close the fence epoch first")
+        self._svc_request(target, ("lock", bool(exclusive)))
+        self._held[target] = "excl" if exclusive else "shared"
+
+    def unlock(self, target: int) -> None:
+        """Close the passive epoch at ``target`` (MPI_Win_unlock). All
+        RMA issued under the lock is already complete (operations are
+        synchronous); this releases the lock and wakes FIFO waiters."""
+        self._require_locks("unlock")
+        if target not in self._held:
+            raise MpiError(
+                f"mpi_tpu: Window.unlock({target}) without holding a "
+                f"lock on that rank")
+        self._svc_request(target, ("unlock",))
+        del self._held[target]
+
+    def lock_all(self) -> None:
+        """Shared lock on every rank (MPI_Win_lock_all), in rank order."""
+        self._require_locks("lock_all")
+        for r in range(self._comm.size()):
+            self.lock(r, exclusive=False)
+
+    def unlock_all(self) -> None:
+        """Release every lock taken by :meth:`lock_all`."""
+        self._require_locks("unlock_all")
+        for r in range(self._comm.size()):
+            self.unlock(r)
+
+    def flush(self, target: int) -> None:
+        """Complete all my RMA at ``target`` (MPI_Win_flush). Passive
+        operations execute synchronously here, so this is an ordering
+        ping: it round-trips the service thread, proving every earlier
+        operation from this origin has been applied."""
+        self._require_locks("flush")
+        if target not in self._held:
+            raise MpiError(
+                f"mpi_tpu: Window.flush({target}) outside a lock epoch")
+        self._svc_request(target, ("flush",))
+
+    def flush_all(self) -> None:
+        """:meth:`flush` every locked target (MPI_Win_flush_all)."""
+        self._require_locks("flush_all")
+        for r in sorted(self._held):
+            self.flush(r)
+
+    # -- passive-target service thread (the software progress engine) ------
+
+    def _serve(self) -> None:
+        me = self._comm.rank()
+        while True:
+            src, msg = self._comm.receive_any(self._svc_tag)
+            kind = msg[0]
+            if kind == "shutdown" and src == me:
+                return
+            try:
+                reply = self._svc_handle(src, msg)
+            except Exception as exc:  # noqa: BLE001 — a user accumulate
+                # op may raise ANYTHING; the thread dying silently would
+                # turn that error into a permanent distributed hang
+                # (origin blocked in _svc_request, free() blocked on the
+                # shutdown rendezvous). Reply with the error instead.
+                reply = ("err", f"{type(exc).__name__}: {exc}")
+            if reply is not None:  # None = deferred (queued lock waiter)
+                self._comm.send(reply, src, self._reply_tag)
+
+    def _svc_handle(self, src: int, msg: Tuple) -> Optional[Tuple]:
+        kind = msg[0]
+        if kind == "lock":
+            exclusive = msg[1]
+            if self._lk_waiters or self._lk_conflicts(exclusive):
+                self._lk_waiters.append((src, exclusive))
+                return None  # granted later, strictly FIFO
+            self._lk_grant(src, exclusive)
+            return ("ok", None)
+        if kind == "unlock":
+            if self._lk_excl == src:
+                self._lk_excl = None
+            elif src in self._lk_shared:
+                self._lk_shared.discard(src)
+            else:
+                return ("err",
+                        f"mpi_tpu: rank {src} unlocked a window lock "
+                        f"it does not hold")
+            # Wake the FIFO waiters that can now hold (grant state is
+            # already applied inside _lk_take_grantable), then answer
+            # the unlocker; the order is unobservable to it.
+            for waiter, _excl in self._lk_take_grantable():
+                self._comm.send(("ok", None), waiter, self._reply_tag)
+            return ("ok", None)
+        if kind == "flush":
+            self._lk_check_holder(src, "flush")
+            return ("ok", None)
+        if kind == "apply":
+            _, offset, arr, op, fetch = msg
+            self._lk_check_holder(src, "RMA")
+            span = slice(offset, offset + arr.shape[0])
+            with self._lock:
+                pre = self._local[span].copy() if fetch else None
+                if op is None:
+                    self._local[span] = arr
+                else:
+                    self._local[span] = np.asarray(
+                        combine(self._local[span], arr, op),
+                        dtype=self._local.dtype)
+            return ("ok", pre)
+        if kind == "get":
+            _, offset, count = msg
+            self._lk_check_holder(src, "RMA")
+            with self._lock:
+                return ("ok", self._local[offset:offset + count].copy())
+        return ("err", f"mpi_tpu: unknown window service request "
+                       f"{kind!r}")
+
+    def _lk_conflicts(self, exclusive: bool) -> bool:
+        if exclusive:
+            return self._lk_excl is not None or bool(self._lk_shared)
+        return self._lk_excl is not None
+
+    def _lk_grant(self, src: int, exclusive: bool) -> None:
+        if exclusive:
+            self._lk_excl = src
+        else:
+            self._lk_shared.add(src)
+
+    def _lk_take_grantable(self) -> List[Tuple[int, bool]]:
+        """Pop the FIFO prefix of waiters that can hold simultaneously:
+        one exclusive, or a run of consecutive shared requests."""
+        out: List[Tuple[int, bool]] = []
+        while self._lk_waiters:
+            src, excl = self._lk_waiters[0]
+            if self._lk_conflicts(excl) or (excl and out):
+                break
+            self._lk_waiters.popleft()
+            self._lk_grant(src, excl)  # mark held NOW so conflicts see it
+            out.append((src, excl))
+        # _lk_grant already applied; callers must not re-grant.
+        return out
+
+    def _lk_check_holder(self, src: int, what: str) -> None:
+        if self._lk_excl != src and src not in self._lk_shared:
+            raise MpiError(
+                f"mpi_tpu: passive {what} from rank {src} outside a "
+                f"lock epoch (MPI_Win_lock first)")
 
     # -- synchronization ---------------------------------------------------
 
@@ -232,6 +501,11 @@ class Window:
         in (source rank, issue order), then serves every queued get from
         the updated windows. On return all RMA issued before the fence
         is complete everywhere."""
+        if self._held:
+            raise MpiError(
+                f"mpi_tpu: Window.fence while holding passive locks on "
+                f"ranks {sorted(self._held)} — unlock first (MPI forbids "
+                f"mixing synchronization modes in one epoch)")
         n = self._comm.size()
         with self._lock:
             puts, self._puts = self._puts, []
@@ -304,7 +578,11 @@ class Window:
 
     def free(self) -> None:
         """Release the window (MPI_Win_free). Collective by convention;
-        pending (un-fenced) RMA is an error."""
+        pending (un-fenced) RMA or a held passive lock is an error."""
+        if self._held:
+            raise MpiError(
+                f"mpi_tpu: Window.free() while holding passive locks "
+                f"on ranks {sorted(self._held)}")
         with self._lock:
             if self._puts or self._gets:
                 raise MpiError(
@@ -313,16 +591,27 @@ class Window:
             # freed window must not pin (or keep handing out) memory.
             self._shared = None
             self._freed = True
+        if self._svc_thread is not None:
+            # Stop my service thread (each rank stops its own; free is
+            # collective, so peers do the same). A peer request racing
+            # the shutdown is erroneous per MPI and may hang that peer.
+            self._comm.send(("shutdown",), self._comm.rank(),
+                            self._svc_tag)
+            self._svc_thread.join(timeout=30.0)
+            self._svc_thread = None
 
 
-def win_create(comm: Comm, local: Any) -> Window:
+def win_create(comm: Comm, local: Any, locks: bool = False) -> Window:
     """Create an RMA window over ``comm`` (MPI_Win_create): collective;
     ``local`` is this rank's exposed 1-D array (its dtype must agree
     across ranks; extents may differ). Mutating ``local`` directly is
-    legal between fences; remote access completes at fences."""
+    legal between fences; remote access completes at fences.
+    ``locks=True`` (collective — every member must agree) additionally
+    enables passive-target lock/unlock epochs, running a per-rank
+    service thread (see the module doc for the tradeoff)."""
     arr = np.asarray(local)
     if arr.ndim != 1:
         raise MpiError(
             f"mpi_tpu: window memory must be a 1-D array, got shape "
             f"{arr.shape}")
-    return Window(comm, arr)
+    return Window(comm, arr, locks=locks)

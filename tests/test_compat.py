@@ -1129,3 +1129,51 @@ class TestDatatypes:
         b = np.zeros((4, 4))
         with pytest.raises(api.MpiError, match="C-contiguous"):
             _RecvTarget([b[:, :2], 8], "Recv")
+
+
+class TestWinPassive:
+    def test_lock_unlock_counter_and_flush(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            mem = np.zeros(1, np.int64)
+            win = MPI.Win.Create(mem, comm=comm,
+                                 info={"locks": "true"})
+            result = np.zeros(1, np.int64)
+            win.Lock(0, MPI.LOCK_EXCLUSIVE)
+            win.Fetch_and_op(np.int64(1), result, 0)
+            win.Flush(0)
+            win.Unlock(0)
+            comm.Barrier()
+            total = int(mem[0]) if r == 0 else None
+            # shared read of the final value
+            got = np.zeros(1, np.int64)
+            win.Lock(0, MPI.LOCK_SHARED)
+            win.Get(got, 0)
+            win.Unlock(0)
+            comm.Barrier()
+            win.Free()
+            MPI.Finalize()
+            return int(result[0]), total, int(got[0])
+
+        res = run_spmd(main, n=3)
+        tickets = sorted(t for t, _, _ in res)
+        assert tickets == [0, 1, 2]
+        assert res[0][1] == 3
+        assert all(g == 3 for _, _, g in res)
+
+    def test_lock_requires_info(self):
+        def main():
+            MPI, comm = _world()
+            win = MPI.Win.Create(np.zeros(1), comm=comm)
+            try:
+                win.Lock(0)
+                out = "no error"
+            except api.MpiError as e:
+                out = "locks" in str(e)
+            win.Free()
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert all(r is True for r in res)

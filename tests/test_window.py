@@ -362,3 +362,192 @@ class TestTcpDriver:
         assert out[1][0] == [0.0, 0.0]
         assert out[2][0] == [0.0, 1.0]
         assert all(o[1] == [6.0] for o in out)  # gets see the epoch's accs
+
+
+class TestPassiveTarget:
+    """lock/unlock epochs: RMA applies synchronously via the service
+    thread, exclusive locks serialize, shared locks admit readers."""
+
+    def test_lock_put_get_unlock(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            win = mpi_tpu.win_create(w, np.zeros(2, np.float32),
+                                     locks=True)
+            # Everyone writes its slot-0 into its RIGHT neighbor under
+            # an exclusive lock; no fence anywhere.
+            right = (r + 1) % n
+            win.lock(right)
+            win.put(np.float32([r + 1]), right, 0)
+            got = win.get(right, 0, 1).array.copy()  # sync: sees my put
+            win.unlock(right)
+            w.barrier()           # all passive epochs closed
+            mine = win.local.copy()
+            w.barrier()           # nobody frees while a peer reads
+            win.free()
+            mpi_tpu.finalize()
+            return got.tolist(), mine.tolist()
+
+        res = spmd(main)
+        for r, (got, mine) in enumerate(res):
+            assert got == [r + 1]               # my own write, read back
+            assert mine[0] == ((r - 1) % N) + 1  # left neighbor's write
+
+    def test_exclusive_lock_serializes_read_modify_write(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            win = mpi_tpu.win_create(w, np.zeros(1, np.int64),
+                                     locks=True)
+            # Unlocked read-modify-write would lose updates; the
+            # exclusive lock makes it atomic. Every rank increments
+            # rank 0's counter 5 times.
+            for _ in range(5):
+                win.lock(0, exclusive=True)
+                cur = int(win.get(0, 0, 1).array[0])
+                win.put(np.int64([cur + 1]), 0, 0)
+                win.unlock(0)
+            w.barrier()
+            total = int(win.local[0]) if r == 0 else None
+            w.barrier()
+            win.free()
+            mpi_tpu.finalize()
+            return total
+
+        res = spmd(main)
+        assert res[0] == 5 * N
+
+    def test_fetch_and_op_passive_tickets(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            win = mpi_tpu.win_create(w, np.zeros(1, np.int64),
+                                     locks=True)
+            win.lock(0, exclusive=True)
+            ticket = int(win.fetch_and_op(1, 0, 0).array[0])
+            win.unlock(0)
+            w.barrier()
+            final = int(win.local[0]) if r == 0 else None
+            w.barrier()
+            win.free()
+            mpi_tpu.finalize()
+            return ticket, final
+
+        res = spmd(main)
+        tickets = sorted(t for t, _ in res)
+        assert tickets == list(range(N))         # every ticket distinct
+        assert res[0][1] == N
+
+    def test_shared_locks_concurrent_reads(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            win = mpi_tpu.win_create(
+                w, np.full(1, 7.0, np.float64), locks=True)
+            win.lock_all()
+            vals = [float(win.get(t, 0, 1).array[0]) for t in range(n)]
+            win.flush_all()
+            win.unlock_all()
+            w.barrier()
+            win.free()
+            mpi_tpu.finalize()
+            return vals
+
+        res = spmd(main)
+        for vals in res:
+            assert vals == [7.0] * N
+
+    def test_errors_and_mode_mixing(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            nolocks = mpi_tpu.win_create(w, np.zeros(1, np.float32))
+            try:
+                nolocks.lock(0)
+                out1 = "no error"
+            except api.MpiError as e:
+                out1 = "locks=True" in str(e)
+            win = mpi_tpu.win_create(w, np.zeros(1, np.float32),
+                                     locks=True)
+            try:
+                win.unlock(0)
+                out2 = "no error"
+            except api.MpiError as e:
+                out2 = "without holding" in str(e)
+            win.lock(r)  # self-lock works
+            try:
+                win.fence()
+                out3 = "no error"
+            except api.MpiError as e:
+                out3 = "mixing synchronization" in str(e)
+            win.unlock(r)
+            w.barrier()
+            win.free()
+            nolocks.free()
+            mpi_tpu.finalize()
+            return out1, out2, out3
+
+        res = spmd(main)
+        for trip in res:
+            assert trip == (True, True, True)
+
+    def test_passive_over_tcp_cluster(self):
+        """The same counter pattern over the real socket driver (the
+        service thread engine on separate sockets, not in-process
+        rendezvous)."""
+        def body(net, r):
+            from mpi_tpu.comm import Comm
+            w = Comm(net, tuple(range(net.size())), 0)
+            win = mpi_tpu.win_create(w, np.zeros(1, np.int64),
+                                     locks=True)
+            for _ in range(3):
+                win.lock(0, exclusive=True)
+                cur = int(win.get(0, 0, 1).array[0])
+                win.put(np.int64([cur + 1]), 0, 0)
+                win.unlock(0)
+            w.barrier()
+            total = int(win.local[0]) if r == 0 else None
+            w.barrier()
+            win.free()
+            return total
+
+        with tcp_cluster(3) as nets:
+            out = run_on_ranks(nets, body)
+        assert out[0] == 9
+
+    def test_raising_accumulate_op_reports_not_hangs(self):
+        """A user op that raises inside the service thread must surface
+        at the ORIGIN as an error (and leave the window serviceable),
+        never kill the progress thread into a distributed hang."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            win = mpi_tpu.win_create(w, np.zeros(1, np.float64),
+                                     locks=True)
+            win.lock(0)
+            try:
+                win.accumulate(np.float64([1.0]), 0, 0, op=_bad_op)
+                out = "no error"
+            except api.MpiError as e:
+                out = "boom" in str(e)
+            # The service thread must still serve afterwards.
+            win.put(np.float64([r + 1.0]), 0, 0)
+            got = float(win.get(0, 0, 1).array[0])
+            win.unlock(0)
+            w.barrier()
+            win.free()
+            mpi_tpu.finalize()
+            return out, got == r + 1.0
+
+        res = spmd(main, n=2)
+        assert all(o is True and g for o, g in res)
+
+
+def _bad_op(a, b):
+    raise ZeroDivisionError("boom")
