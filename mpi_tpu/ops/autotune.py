@@ -167,8 +167,25 @@ def tune_flash_blocks(batch: int, seq: int, heads: int, head_dim: int,
         return jax.jit(lambda q, k, v: flash_attention(
             q, k, v, causal, bq, bk, interpret))
 
+    # Each candidate costs a kernel compile — through a tunnel that is
+    # 20-40 s each. A sweep deadline (MPI_TPU_TUNE_DEADLINE_S, 0
+    # disables) stops after the candidate in flight and takes the best
+    # so far, so the caller's own budget (e.g. the bench train leg's
+    # subprocess timeout) is never blown by tuning alone; the truncated
+    # marker in the table records which configs went unmeasured.
+    deadline_s = float(os.environ.get("MPI_TPU_TUNE_DEADLINE_S", "300"))
+    t_start = time.monotonic()
     table = []
     for bq, bk in effective:
+        # Truncate only once something actually TIMED — a prefix of
+        # failed candidates (VMEM misfits) must not cut off the
+        # still-viable rest, however long their failed compiles took.
+        if deadline_s > 0 and any("ms" in t for t in table) \
+                and time.monotonic() - t_start > deadline_s:
+            table.append({"block_q": bq, "block_k": bk,
+                          "error": "untried: tune deadline "
+                                   f"({deadline_s:.0f}s) reached"})
+            continue
         fn = build(bq, bk)
         try:
             _time_once(fn, q, k, v)  # compile + warm
@@ -187,8 +204,14 @@ def tune_flash_blocks(batch: int, seq: int, heads: int, head_dim: int,
             f"({[t.get('error') for t in table][:3]})")
     timed.sort(key=lambda t: t["ms"])
     best = (timed[0]["block_q"], timed[0]["block_k"])
+    truncated = any("untried" in str(t.get("error", "")) for t in table)
+    # A truncated winner serves THIS process (re-tuning now would blow
+    # the same deadline again) but is never persisted: the next run —
+    # with time to finish the sweep — must not inherit a
+    # first-candidates-only result as if it were the full verdict.
     _cache[key] = best
-    _disk_cache_store(key, best)
+    if not truncated:
+        _disk_cache_store(key, best)
     if set_default:
         register_tuned_blocks(seq, tk, *best)
     return best, timed + [t for t in table if "ms" not in t]

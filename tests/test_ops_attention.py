@@ -311,3 +311,29 @@ class TestAutotune:
         finally:
             _cache.clear()
             _tuned_blocks.clear()
+
+
+def test_tune_deadline_truncates_with_best_so_far(monkeypatch, tmp_path):
+    """A sweep deadline keeps the first candidate's result and marks
+    the rest untried — tuning can never blow the caller's own budget —
+    and a truncated winner must NOT persist to the disk cache (the
+    next unhurried run re-tunes the full sweep)."""
+    from mpi_tpu.ops import autotune
+
+    cache_file = tmp_path / "tune.json"
+    monkeypatch.setenv("MPI_TPU_TUNE_DEADLINE_S", "0.000001")
+    monkeypatch.setenv("MPI_TPU_TUNE_CACHE", str(cache_file))
+    autotune._cache.clear()
+    try:
+        best, table = autotune.tune_flash_blocks(
+            1, 128, 2, 32, reps=1, set_default=False,
+            candidates=[(128, 128), (128, 256), (64, 128)])
+        timed = [t for t in table if "ms" in t]
+        untried = [t for t in table
+                   if "untried" in str(t.get("error", ""))]
+        assert len(timed) == 1      # the in-flight candidate finished
+        assert untried              # the rest were cut, visibly
+        assert best == (timed[0]["block_q"], timed[0]["block_k"])
+        assert not cache_file.exists()  # truncated -> not persisted
+    finally:
+        autotune._cache.clear()
