@@ -30,7 +30,7 @@ point-to-point library over TCP, /root/reference) designed TPU-first:
     fallbacks.
 """
 
-from .comm import CartComm, Comm, cart_create, comm_world
+from .comm import CartComm, Comm, cart_create, comm_self, comm_world
 from .distgraph import DistGraphComm, dist_graph_create_adjacent
 from .intercomm import Intercomm, create_intercomm
 from .io import File, open_file
@@ -102,6 +102,7 @@ __all__ = [
     "Window",
     "win_create",
     "cart_create",
+    "comm_self",
     "comm_world",
     "run_main",
     "selected_backend",

@@ -58,7 +58,8 @@ if TYPE_CHECKING:
 
 from .api import Interface, MpiError, Request, exchange as _exchange
 
-__all__ = ["Comm", "CartComm", "cart_create", "comm_world", "CTX_SPAN",
+__all__ = ["Comm", "CartComm", "cart_create", "comm_world", "comm_self",
+           "SELF_CTX", "CTX_SPAN",
            "USER_TAG_SPAN"]
 
 CTX_SPAN = 1 << 44        # tag-space region per context
@@ -664,6 +665,29 @@ def comm_world(impl: Optional[Interface] = None) -> Comm:
     if impl is None:
         impl = api._require_init()
     return Comm(impl, tuple(range(impl.size())), 0)
+
+
+# Reserved context for self-communicators: directly BELOW the
+# create_group bootstrap band (_CTX_MAX - 1 - tag for tag in
+# [0, _CREATE_GROUP_TAGS)) so no bootstrap comm can ever alias it, and
+# far above anything split negotiation reaches in a real run. Safe to
+# share across ranks — every self-comm link is {me, me}, so two ranks'
+# self-comms can never exchange (or capture) each other's traffic.
+SELF_CTX = (1 << 62) // CTX_SPAN - _CREATE_GROUP_TAGS - 2
+
+
+def comm_self(impl: Optional[Interface] = None) -> Comm:
+    """MPI_COMM_SELF: a communicator containing only this rank.
+    Creation is purely local (no negotiation round — MPI requires
+    COMM_SELF to exist without collective calls), at the reserved
+    :data:`SELF_CTX` context. Collectives on it are identities;
+    send/receive are self-rendezvous; it makes e.g. per-rank private
+    file IO (``open_file(comm_self(), ...)``) spell the same as MPI."""
+    from . import api
+
+    if impl is None:
+        impl = api._require_init()
+    return Comm(impl, (impl.rank(),), SELF_CTX)
 
 
 class CartComm(Comm):

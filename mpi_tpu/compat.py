@@ -64,12 +64,14 @@ end as usual. No reference analogue (pure framework-usability work).
 
 from __future__ import annotations
 
+import itertools as _itertools
+import threading as _threading
 from typing import Any, List, Optional
 
 import numpy as np
 
 from . import api
-from .comm import Comm as _NativeComm, comm_world
+from .comm import Comm as _NativeComm, comm_self, comm_world
 
 __all__ = ["MPI"]
 
@@ -206,6 +208,20 @@ class Comm:
 
     def __init__(self, native: _NativeComm):
         self._c = native
+        # MPI attribute caching + names live on the NATIVE communicator
+        # so every wrapper of the same Comm object sees them (wrappers
+        # are cheap views; fresh wrappers of a fresh native — e.g. a
+        # second comm_world() — start clean, which mpi4py's handle
+        # semantics also allow). Keyed BY GROUP RANK: under the
+        # thread-per-rank drivers every rank-thread shares one native
+        # world comm, and MPI attributes are per-process state — one
+        # rank's Set_attr must never be visible to another.
+        if not hasattr(native, "_compat_attrs"):
+            native._compat_attrs = {}
+            native._compat_names = {}
+
+    def _attrs(self) -> dict:
+        return self._c._compat_attrs.setdefault(self._c.rank(), {})
 
     def __eq__(self, other: Any) -> bool:
         # Wrapper objects are cheap views; communicator identity is the
@@ -666,6 +682,50 @@ class Comm:
 
     def ialltoall(self, sendobj: List[Any]) -> Request:
         return Request(self._c.ialltoall(sendobj))
+
+    # -- attribute caching and names ----------------------------------------
+
+    # itertools.count.__next__ is atomic in CPython — rank-threads
+    # calling Create_keyval concurrently can never share a keyval.
+    _keyval_counter = _itertools.count(1)
+
+    @classmethod
+    def Create_keyval(cls, copy_fn: Any = None, delete_fn: Any = None,
+                      nopython: bool = False) -> int:
+        """A fresh attribute key (MPI_Comm_create_keyval). Copy/delete
+        callbacks are accepted and ignored — attributes here never
+        propagate on Dup (callers re-attach), matching the default
+        MPI_COMM_NULL_COPY_FN behavior."""
+        return next(cls._keyval_counter)
+
+    @classmethod
+    def Free_keyval(cls, keyval: int) -> int:
+        return KEYVAL_INVALID
+
+    def Set_attr(self, keyval: int, attrval: Any) -> None:
+        self._attrs()[keyval] = attrval
+
+    def Get_attr(self, keyval: int) -> Any:
+        """The cached value, or None when unset (mpi4py convention)."""
+        return self._attrs().get(keyval)
+
+    def Delete_attr(self, keyval: int) -> None:
+        self._attrs().pop(keyval, None)
+
+    def Set_name(self, name: str) -> None:
+        self._c._compat_names[self._c.rank()] = str(name)
+
+    def Get_name(self) -> str:
+        name = self._c._compat_names.get(self._c.rank())
+        if name is not None:
+            return name
+        if self._c.context == 0:
+            return "MPI_COMM_WORLD"
+        from .comm import SELF_CTX
+
+        if self._c.context == SELF_CTX and len(self._c.members) == 1:
+            return "MPI_COMM_SELF"
+        return f"mpi_tpu comm ctx={self._c.context}"
 
     # -- construction -------------------------------------------------------
 
@@ -1304,6 +1364,8 @@ MODE_SEQUENTIAL = 256
 LOCK_EXCLUSIVE = 234
 LOCK_SHARED = 235
 
+KEYVAL_INVALID = -1
+
 
 def _writable_buffer(buf: Any, what: str) -> np.ndarray:
     """The caller's receive buffer, as the ndarray written THROUGH
@@ -1894,7 +1956,28 @@ class _MPI:
     Win = Win
     File = File
 
+    KEYVAL_INVALID = KEYVAL_INVALID
+
     _world_cache: Optional[Comm] = None
+    # Thread-local: under thread-per-rank drivers the self-comm's
+    # member list is rank-specific — a shared cache would hand one
+    # rank another rank's COMM_SELF.
+    _self_tls = _threading.local()
+
+    @property
+    def COMM_SELF(self) -> Comm:
+        """A communicator containing only this process (MPI_COMM_SELF)
+        — created locally, no negotiation; the usual spelling for
+        per-rank private file IO (``MPI.File.Open(MPI.COMM_SELF, ...)``)."""
+        if not self.Is_initialized():
+            api.init()
+            self._self_tls.comm = None
+        cached = getattr(self._self_tls, "comm", None)
+        if cached is None or cached._c._impl is not api.registered() \
+                or cached._c.members != (api.registered().rank(),):
+            cached = Comm(comm_self())
+            self._self_tls.comm = cached
+        return cached
 
     @property
     def COMM_WORLD(self) -> Comm:
@@ -1926,6 +2009,18 @@ class _MPI:
         import socket
 
         return socket.gethostname()
+
+    def Get_version(self):
+        """(major, minor) of the MPI standard surface this shim
+        tracks: the MPI-3.1 feature set (nonblocking collectives,
+        RMA incl. passive target, neighborhood collectives)."""
+        return (3, 1)
+
+    def Get_library_version(self) -> str:
+        import mpi_tpu
+
+        return (f"mpi_tpu {getattr(mpi_tpu, '__version__', 'dev')} "
+                f"(tpu-native; drivers: tcp/shm/xla/hybrid)")
 
     def Wtime(self) -> float:
         return api.wtime()

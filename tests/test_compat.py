@@ -1177,3 +1177,111 @@ class TestWinPassive:
 
         res = run_spmd(main, n=2)
         assert all(r is True for r in res)
+
+
+class TestCommSelfAttrsVersion:
+    def test_comm_self_identity_and_ops(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            cs = MPI.COMM_SELF
+            assert cs.Get_size() == 1 and cs.Get_rank() == 0
+            # collectives are identities; p2p is self-rendezvous
+            assert cs.allreduce(r + 1) == r + 1
+            req = cs.isend({"me": r}, dest=0, tag=3)
+            got = cs.recv(source=0, tag=3)
+            req.wait()
+            assert cs is MPI.COMM_SELF          # cached per rank-thread
+            assert cs.Get_name() == "MPI_COMM_SELF"
+            MPI.Finalize()
+            return got["me"]
+
+        res = run_spmd(main, n=3)
+        assert res == [0, 1, 2]          # each rank saw its OWN self
+
+    def test_comm_self_file_io(self, tmp_path):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            path = str(tmp_path / f"rank{r}.bin")
+            f = MPI.File.Open(MPI.COMM_SELF, path,
+                              MPI.MODE_CREATE | MPI.MODE_RDWR)
+            f.Write_at(0, np.full(4, float(r)))
+            out = np.zeros(4)
+            f.Read_at(0, out)
+            f.Close()
+            MPI.Finalize()
+            return out.tolist()
+
+        res = run_spmd(main, n=2)
+        assert res[0] == [0.0] * 4 and res[1] == [1.0] * 4
+
+    def test_attrs_names_version(self):
+        def main():
+            MPI, comm = _world()
+            kv = MPI.Comm.Create_keyval()
+            assert comm.Get_attr(kv) is None
+            comm.Set_attr(kv, {"x": 1})
+            got = comm.Get_attr(kv)
+            comm.Delete_attr(kv)
+            gone = comm.Get_attr(kv)
+            assert comm.Get_name() == "MPI_COMM_WORLD"
+            comm.Set_name("my world")
+            renamed = comm.Get_name()
+            major, minor = MPI.Get_version()
+            lib = MPI.Get_library_version()
+            MPI.Finalize()
+            return got, gone, renamed, (major, minor), "mpi_tpu" in lib
+
+        res = run_spmd(main, n=2)
+        for got, gone, renamed, ver, lib_ok in res:
+            assert got == {"x": 1} and gone is None
+            assert renamed == "my world"
+            assert ver == (3, 1) and lib_ok
+
+    def test_attrs_and_names_are_per_rank(self):
+        """Under thread-per-rank drivers every rank shares ONE native
+        world comm; attributes and names are per-process MPI state and
+        must not leak across ranks."""
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            kv = 777  # fixed key: collisions are the point
+            comm.Set_attr(kv, f"rank{r}-private")
+            comm.Set_name(f"world-of-{r}")
+            comm.Barrier()   # everyone has written
+            out = comm.Get_attr(kv), comm.Get_name()
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=3)
+        for r, (attr, name) in enumerate(res):
+            assert attr == f"rank{r}-private"
+            assert name == f"world-of-{r}"
+
+    def test_self_ctx_survives_create_group_tag1(self):
+        """SELF_CTX must not alias the create_group bootstrap band
+        (ctx = _CTX_MAX - 1 - tag): a single-member create_group at
+        tag=1 once landed exactly on COMM_SELF's context and tore down
+        its engines on free."""
+        from mpi_tpu.comm import SELF_CTX, _CREATE_GROUP_TAGS, CTX_SPAN
+
+        cap = (1 << 62) // CTX_SPAN
+        boot_band = {cap - 1 - t for t in range(_CREATE_GROUP_TAGS)}
+        assert SELF_CTX not in boot_band
+
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            cs = MPI.COMM_SELF
+            assert cs.allreduce(1.0) == 1.0     # engines live
+            solo = comm.native.create_group([r], tag=1)
+            assert solo.size() == 1
+            solo.free()
+            # COMM_SELF must still work after the boot comm freed.
+            assert cs.allreduce(2.0) == 2.0
+            MPI.Finalize()
+            return True
+
+        res = run_spmd(main, n=2)
+        assert all(res)
