@@ -9,7 +9,10 @@ framework's one-sided pillar with the two canonical patterns:
     tickets are reproducible prefix sums rather than a race;
   * a **bulletin board**: every rank puts its contribution into a slot
     of rank 0's window, then everyone gets the full board after the
-    fence.
+    fence;
+  * a **passive-target bank account** (``locks=True``): each rank
+    runs get-modify-put deposits under an exclusive MPI_Win_lock —
+    atomic with no fence and no participation from the target.
 
 Run::
 
@@ -52,6 +55,28 @@ def main() -> None:
         print(f"rank {rank}: ticket {ticket}, board {board}", flush=True)
 
         win.free()
+
+        # Passive target: a "bank account" on rank 0. Each rank makes
+        # 3 deposits via get-modify-put inside an exclusive lock epoch
+        # — the lock (not a fence) makes the read-modify-write atomic,
+        # and rank 0 never calls anything while being updated.
+        bank = mpi_tpu.win_create(world, np.zeros(1, np.int64),
+                                  locks=True)
+        for _ in range(3):
+            bank.lock(0, exclusive=True)
+            balance = int(bank.get(0, 0, 1).array[0])
+            bank.put(np.int64([balance + rank + 1]), 0, 0)
+            bank.unlock(0)
+        world.barrier()
+        if rank == 0:
+            expect = 3 * sum(range(1, size + 1))
+            total = int(bank.local[0])
+            if total != expect:
+                raise SystemExit(f"bank total {total} != {expect}")
+            print(f"rank 0: bank balance {total} after "
+                  f"{3 * size} locked deposits", flush=True)
+        world.barrier()
+        bank.free()
     finally:
         mpi_tpu.finalize()
 

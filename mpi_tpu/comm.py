@@ -673,7 +673,7 @@ def comm_world(impl: Optional[Interface] = None) -> Comm:
 # far above anything split negotiation reaches in a real run. Safe to
 # share across ranks — every self-comm link is {me, me}, so two ranks'
 # self-comms can never exchange (or capture) each other's traffic.
-SELF_CTX = (1 << 62) // CTX_SPAN - _CREATE_GROUP_TAGS - 2
+SELF_CTX = (1 << 62) // CTX_SPAN - _CREATE_GROUP_TAGS - 1
 
 
 def comm_self(impl: Optional[Interface] = None) -> Comm:

@@ -1085,7 +1085,8 @@ class Win:
                comm: Optional[Comm] = None) -> "Win":
         """Collective window creation (``MPI_Win_create``). ``memory``
         is this rank's exposed 1-D numpy array; ``comm`` defaults to
-        ``COMM_WORLD`` (there is no COMM_SELF here). Passive-target
+        ``COMM_WORLD`` (``MPI.COMM_SELF`` works too — a single-rank
+        window). Passive-target
         ``Lock``/``Unlock`` needs ``info={"locks": "true"}`` (every
         member must pass it — it starts the per-rank service thread;
         the inverse of MPI's ``no_locks`` hint, off by default because
@@ -1979,19 +1980,29 @@ class _MPI:
             self._self_tls.comm = cached
         return cached
 
+    _world_lock = _threading.Lock()
+
     @property
     def COMM_WORLD(self) -> Comm:
         # mpi4py initializes at import; the nearest safe analogue is
         # lazy init on first world access. The wrapper is cached so
         # `comm is MPI.COMM_WORLD` identity checks behave like
         # mpi4py's singleton (and __eq__ covers fresh wrappers).
+        # init() runs OUTSIDE the cache lock (it can be collective —
+        # holding the lock across it would deadlock the other rank-
+        # threads it waits for); the cache itself is locked so racing
+        # rank-threads agree on ONE wrapper/native — otherwise
+        # attributes Set_attr'ed through a losing wrapper would
+        # silently vanish from later COMM_WORLD accesses.
         if not self.Is_initialized():
             api.init()
-            self._world_cache = None
-        if self._world_cache is None \
-                or self._world_cache._c._impl is not api.registered():
-            self._world_cache = Comm(comm_world())
-        return self._world_cache
+            with self._world_lock:
+                self._world_cache = None
+        with self._world_lock:
+            if self._world_cache is None \
+                    or self._world_cache._c._impl is not api.registered():
+                self._world_cache = Comm(comm_world())
+            return self._world_cache
 
     def Init(self) -> None:
         if not self.Is_initialized():

@@ -129,6 +129,7 @@ class Window:
         self._held: Dict[int, str] = {}      # target -> "excl"/"shared"
         self._origin_lock = threading.Lock()  # serialize my requests
         self._svc_thread: Optional[threading.Thread] = None
+        self._svc_stop = False
         # (target, offset, payload, op, fetch_handle): op None = put;
         # a non-None handle makes it a get_accumulate (pre-value read).
         self._puts: List[Tuple[int, int, np.ndarray, Optional[OpLike],
@@ -397,22 +398,64 @@ class Window:
     # -- passive-target service thread (the software progress engine) ------
 
     def _serve(self) -> None:
+        """Probe-serve loop. Hand-rolled rather than ``receive_any``:
+        during teardown a finalized peer's closed sockets make that
+        peer's PROBE raise, which would kill the thread mid-sweep and
+        leave live peers (and free()) hanging — here a raising source
+        just counts as nothing-to-serve. Shutdown is flag-based
+        (free() sets ``_svc_stop``), not a message: a message to an
+        already-dead thread would rendezvous forever."""
+        import sys as _sys
+        import time as _time
+
         me = self._comm.rank()
-        while True:
-            src, msg = self._comm.receive_any(self._svc_tag)
-            kind = msg[0]
-            if kind == "shutdown" and src == me:
-                return
+        n = self._comm.size()
+        probe_errs: set = set()
+        while not self._svc_stop:
+            got = None
+            for off in range(n):
+                src = (me + off) % n
+                try:
+                    if self._comm.iprobe(src, self._svc_tag):
+                        got = (src,
+                               self._comm.receive(src, self._svc_tag))
+                        break
+                except (ConnectionError, OSError):
+                    # A finalized/dead peer (normal teardown order:
+                    # some ranks finalize while others still hold
+                    # their windows) — nothing to serve from it.
+                    continue
+                except Exception as exc:  # noqa: BLE001 — anything
+                    # else is a real defect (driver without iprobe,
+                    # transport bug); logged ONCE per (source, type)
+                    # so it is never silently indistinguishable from
+                    # nothing-to-serve while origins hang.
+                    sig = (src, type(exc).__name__)
+                    if sig not in probe_errs:
+                        probe_errs.add(sig)
+                        print(f"mpi_tpu: window service (rank {me}): "
+                              f"probe of rank {src} raised "
+                              f"{type(exc).__name__}: {exc} — treating "
+                              f"that source as unavailable",
+                              file=_sys.stderr)
+                    continue
+            if got is None:
+                _time.sleep(0.0005)
+                continue
+            src, msg = got
             try:
                 reply = self._svc_handle(src, msg)
             except Exception as exc:  # noqa: BLE001 — a user accumulate
                 # op may raise ANYTHING; the thread dying silently would
                 # turn that error into a permanent distributed hang
-                # (origin blocked in _svc_request, free() blocked on the
-                # shutdown rendezvous). Reply with the error instead.
+                # (origin blocked in _svc_request). Reply the error.
                 reply = ("err", f"{type(exc).__name__}: {exc}")
             if reply is not None:  # None = deferred (queued lock waiter)
-                self._comm.send(reply, src, self._reply_tag)
+                try:
+                    self._comm.send(reply, src, self._reply_tag)
+                except Exception:  # noqa: BLE001 — origin died mid-
+                    # request (erroneous program); keep serving others.
+                    pass
 
     def _svc_handle(self, src: int, msg: Tuple) -> Optional[Tuple]:
         kind = msg[0]
@@ -593,10 +636,11 @@ class Window:
             self._freed = True
         if self._svc_thread is not None:
             # Stop my service thread (each rank stops its own; free is
-            # collective, so peers do the same). A peer request racing
-            # the shutdown is erroneous per MPI and may hang that peer.
-            self._comm.send(("shutdown",), self._comm.rank(),
-                            self._svc_tag)
+            # collective, so peers do the same). Flag-based: the serve
+            # loop polls it every sweep, so the join is bounded. A peer
+            # request racing the shutdown is erroneous per MPI and may
+            # hang that peer.
+            self._svc_stop = True
             self._svc_thread.join(timeout=30.0)
             self._svc_thread = None
 
