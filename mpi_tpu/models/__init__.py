@@ -32,6 +32,9 @@ from .lora import (lora_init, make_lora_train_parts, make_lora_train_step,
 from .vit import (ViTConfig, forward_vit, init_vit_params,
                   make_vit_train_step)
 from .speculative import generate_lookahead
+from .ssm import (SsmConfig, init_ssm_params, init_ssm_state,
+                  make_ssm_train_step, ssm_decode, ssm_forward,
+                  ssm_step)
 from .pipeline_lm import (
     forward_pipelined,
     init_pipelined_params,
@@ -41,6 +44,13 @@ from .pipeline_lm import (
 
 __all__ = [
     "TransformerConfig",
+    "SsmConfig",
+    "init_ssm_params",
+    "init_ssm_state",
+    "make_ssm_train_step",
+    "ssm_decode",
+    "ssm_forward",
+    "ssm_step",
     "QTensor",
     "quantize",
     "quantize_params",

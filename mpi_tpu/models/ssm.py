@@ -1,0 +1,279 @@
+"""State-space sequence model (LRU family) — the non-attention LM.
+
+A diagonal complex linear recurrence (Linear Recurrent Unit, the
+S4/S5-family member with the simplest exact math) interleaved with
+gated MLPs: where the Transformer mixes time with attention's O(s²)
+matmuls, this mixes time with an O(s) scan that XLA lowers to an
+O(log s)-depth ``lax.associative_scan`` — the TPU-native way to run a
+recurrence (no serial loop, no dynamic shapes), with the MXU fed by
+the surrounding projections and MLP. Training is full-sequence
+parallel like the Transformer; decoding carries an O(1)-per-token
+recurrent state instead of a KV cache that grows with context.
+
+Per layer, over hidden size ``d`` and state size ``h``::
+
+    lam = exp(-exp(nu_log) + i * exp(theta_log))     # |lam| < 1
+    gam = sqrt(1 - |lam|^2)                          # input normalizer
+    x_t = lam * x_{t-1} + gam * (u_t @ B)            # complex diagonal
+    y_t = Re(x_t @ C) + D * u_t                      # read-out + skip
+
+The recurrence runs in complex64 (f32 pairs — stability), everything
+matmul-shaped runs in ``cfg.dtype`` (bf16 on TPU). No reference
+analogue (the reference has no ML code at all; SURVEY.md §2); this is
+model-zoo breadth on the shared training stack (same optimizer,
+token_xent loss, and checkpoint format as the Transformer).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .transformer import (_dense_init, _layernorm, make_optimizer,
+                          token_xent)
+
+__all__ = ["SsmConfig", "init_ssm_params", "ssm_forward",
+           "make_ssm_train_step", "ssm_decode", "init_ssm_state",
+           "ssm_step"]
+
+
+@dataclass(frozen=True)
+class SsmConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    d_state: int = 64          # per-layer complex state size
+    d_ff: int = 512
+    dtype: Any = jnp.float32   # matmul compute dtype (bf16 on TPU)
+    # |lam| initialized uniform in [r_min, r_max) — long memories near 1.
+    r_min: float = 0.4
+    r_max: float = 0.99
+
+
+def _uniform(key, shape, lo, hi):
+    return jax.random.uniform(key, shape, jnp.float32, lo, hi)
+
+
+def init_ssm_params(cfg: SsmConfig, key: jax.Array) -> Dict[str, Any]:
+    """Parameter pytree (float32 masters, like the Transformer's)."""
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    d, h, f = cfg.d_model, cfg.d_state, cfg.d_ff
+
+    def glorot(k, shape):
+        return _dense_init(k, shape, jnp.float32, shape[0])
+
+    blocks = []
+    for i in range(cfg.n_layers):
+        ks = jax.random.split(keys[2 + i], 8)
+        # LRU ring init: lam = exp(-exp(nu) + i exp(theta)), |lam| in
+        # [r_min, r_max), phase uniform over the circle's first half.
+        u1 = _uniform(ks[0], (h,), 0.0, 1.0)
+        mod = jnp.sqrt(u1 * (cfg.r_max ** 2 - cfg.r_min ** 2)
+                       + cfg.r_min ** 2)
+        nu_log = jnp.log(-jnp.log(mod))
+        theta_log = jnp.log(_uniform(ks[1], (h,), 0.0, math.pi))
+        blocks.append({
+            "nu_log": nu_log,
+            "theta_log": theta_log,
+            "b_re": glorot(ks[2], (d, h)),
+            "b_im": glorot(ks[3], (d, h)),
+            "c_re": glorot(ks[4], (h, d)),
+            "c_im": glorot(ks[5], (h, d)),
+            "d_skip": jnp.zeros((d,), jnp.float32),
+            "ln1": {"scale": jnp.ones((d,), jnp.float32),
+                    "bias": jnp.zeros((d,), jnp.float32)},
+            "w1": glorot(ks[6], (d, f)),
+            "w2": glorot(ks[7], (f, d)),
+            "ln2": {"scale": jnp.ones((d,), jnp.float32),
+                    "bias": jnp.zeros((d,), jnp.float32)},
+        })
+    return {
+        "embed": (jax.random.normal(keys[0], (cfg.vocab, d))
+                  / math.sqrt(d)).astype(jnp.float32),
+        "blocks": blocks,
+        "ln_f": {"scale": jnp.ones((d,), jnp.float32),
+                 "bias": jnp.zeros((d,), jnp.float32)},
+        "head": glorot(keys[1], (d, cfg.vocab)),
+    }
+
+
+def _lam_gam(blk) -> Tuple[jax.Array, jax.Array]:
+    lam = jnp.exp(-jnp.exp(blk["nu_log"])
+                  + 1j * jnp.exp(blk["theta_log"])).astype(jnp.complex64)
+    gam = jnp.sqrt(jnp.maximum(1.0 - jnp.abs(lam) ** 2, 1e-8)
+                   ).astype(jnp.complex64)
+    return lam, gam
+
+
+def _lru_scan(blk, u: jax.Array) -> jax.Array:
+    """The recurrence over a full sequence: u (b, s, d) -> y (b, s, d).
+
+    ``associative_scan`` over the first-order linear-recurrence monoid
+    ``(a2, b2) . (a1, b1) = (a2*a1, a2*b1 + b2)`` — O(log s) depth, no
+    serial loop, exactly the sequential recurrence's values."""
+    lam, gam = _lam_gam(blk)
+    # Drive term in complex64: (b, s, h)
+    drive = jnp.einsum("bsd,dh->bsh", u.astype(jnp.float32),
+                       blk["b_re"]) + 1j * jnp.einsum(
+        "bsd,dh->bsh", u.astype(jnp.float32), blk["b_im"])
+    drive = gam[None, None] * drive.astype(jnp.complex64)
+    a = jnp.broadcast_to(lam[None, None], drive.shape)
+
+    def combine(left, right):
+        a1, b1 = left
+        a2, b2 = right
+        return a2 * a1, a2 * b1 + b2
+
+    _, x = lax.associative_scan(combine, (a, drive), axis=1)
+    y = (jnp.einsum("bsh,hd->bsd", x.real, blk["c_re"])
+         - jnp.einsum("bsh,hd->bsd", x.imag, blk["c_im"]))
+    return y.astype(u.dtype) + blk["d_skip"].astype(u.dtype) * u
+
+
+def _block(blk, x: jax.Array) -> jax.Array:
+    h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
+                   blk["ln1"]["bias"].astype(x.dtype))
+    x = x + _lru_scan(blk, h)
+    h = _layernorm(x, blk["ln2"]["scale"].astype(x.dtype),
+                   blk["ln2"]["bias"].astype(x.dtype))
+    h = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h,
+                               blk["w1"].astype(x.dtype)))
+    return x + jnp.einsum("bsf,fd->bsd", h, blk["w2"].astype(x.dtype))
+
+
+def ssm_forward(cfg: SsmConfig, params: Dict[str, Any],
+                tokens: jax.Array) -> jax.Array:
+    """tokens (b, s) int32 -> logits (b, s, vocab). Strictly causal:
+    position t sees tokens[:, :t+1] only (the recurrence is the proof)."""
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    for blk in params["blocks"]:
+        x = _block(blk, x)
+    x = _layernorm(x, params["ln_f"]["scale"].astype(x.dtype),
+                   params["ln_f"]["bias"].astype(x.dtype))
+    return jnp.einsum("bsd,dv->bsv", x, params["head"].astype(x.dtype))
+
+
+# -- recurrent decode (O(1) per token; the KV-cache-free serving story) --
+
+def init_ssm_state(cfg: SsmConfig, batch: int) -> list:
+    """Per-layer recurrent state, all zeros (no context yet)."""
+    return [jnp.zeros((batch, cfg.d_state), jnp.complex64)
+            for _ in range(cfg.n_layers)]
+
+
+def ssm_step(cfg: SsmConfig, params: Dict[str, Any], state: list,
+             tokens: jax.Array) -> Tuple[list, jax.Array]:
+    """One token step: tokens (b,) int32 -> (new_state, logits (b, v)).
+    Bitwise the same recurrence the scan runs, carried explicitly."""
+    x = params["embed"].astype(cfg.dtype)[tokens]  # (b, d)
+    new_state = []
+    for blk, s in zip(params["blocks"], state):
+        h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
+                       blk["ln1"]["bias"].astype(x.dtype))
+        lam, gam = _lam_gam(blk)
+        drive = (jnp.einsum("bd,dh->bh", h.astype(jnp.float32),
+                            blk["b_re"])
+                 + 1j * jnp.einsum("bd,dh->bh",
+                                   h.astype(jnp.float32), blk["b_im"]))
+        s = lam[None] * s + gam[None] * drive.astype(jnp.complex64)
+        new_state.append(s)
+        y = (jnp.einsum("bh,hd->bd", s.real, blk["c_re"])
+             - jnp.einsum("bh,hd->bd", s.imag, blk["c_im"])
+             ).astype(x.dtype) + blk["d_skip"].astype(x.dtype) * h
+        x = x + y
+        h2 = _layernorm(x, blk["ln2"]["scale"].astype(x.dtype),
+                        blk["ln2"]["bias"].astype(x.dtype))
+        h2 = jax.nn.gelu(jnp.einsum("bd,df->bf", h2,
+                                    blk["w1"].astype(x.dtype)))
+        x = x + jnp.einsum("bf,fd->bd", h2, blk["w2"].astype(x.dtype))
+    x = _layernorm(x, params["ln_f"]["scale"].astype(x.dtype),
+                   params["ln_f"]["bias"].astype(x.dtype))
+    return new_state, jnp.einsum("bd,dv->bv", x,
+                                 params["head"].astype(x.dtype))
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def ssm_decode(cfg: SsmConfig, params: Dict[str, Any],
+               prompt: jax.Array, n_new: int) -> jax.Array:
+    """Greedy decode: prompt (b, p) int32 -> (b, p + n_new), one jitted
+    program (prefill scan + generate scan) carrying the O(1) recurrent
+    state — decode cost per token is independent of how much context
+    came before (the structural advantage over KV-cache attention)."""
+    b, p = prompt.shape
+    if n_new <= 0 or p == 0:
+        # p == 0 would make the prefill scan's last-logits read
+        # undefined; unconditional generation starts from a BOS-style
+        # prompt of at least one token.
+        return prompt
+
+    state = init_ssm_state(cfg, b)
+    state, logits = lax.scan(
+        lambda st, t: ssm_step(cfg, params, st, t), state,
+        jnp.transpose(prompt, (1, 0)))
+    first = jnp.argmax(logits[-1], axis=-1).astype(prompt.dtype)
+
+    def step(carry, _):
+        st, tok = carry
+        st, lg = ssm_step(cfg, params, st, tok)
+        nxt = jnp.argmax(lg, axis=-1).astype(prompt.dtype)
+        # Emit the token we just CONSUMED: the scan's outputs are then
+        # exactly the n_new generated tokens in order.
+        return (st, nxt), tok
+
+    _, toks = lax.scan(step, (state, first), None, length=n_new)
+    return jnp.concatenate([prompt, jnp.transpose(toks, (1, 0))],
+                           axis=1)
+
+
+def make_ssm_train_step(cfg: SsmConfig, learning_rate: float = 1e-3,
+                        optimizer: str = "adamw",
+                        mesh: Optional[Any] = None):
+    """(init_state, jitted step). ``step(state, tokens)`` consumes
+    (b, s+1) int32 — inputs ``tokens[:, :-1]``, targets
+    ``tokens[:, 1:]`` — and returns (state, loss), same shape contract
+    as the Transformer's trainer. With ``mesh`` (a ``dp`` axis), the
+    batch shards over dp and GSPMD inserts the gradient psum."""
+    import optax
+
+    opt = make_optimizer(optimizer, learning_rate)
+
+    def init_state(key):
+        params = init_ssm_params(cfg, key)
+        return {"params": params, "opt": opt.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def loss_fn(params, tokens):
+        logits = ssm_forward(cfg, params, tokens[:, :-1])
+        return token_xent(logits, tokens[:, 1:])
+
+    def step_body(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"],
+                                                  tokens)
+        updates, new_opt = opt.update(grads, state["opt"],
+                                      state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "opt": new_opt,
+                "step": state["step"] + 1}, loss
+
+    if mesh is None:
+        return init_state, jax.jit(step_body)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tok_sharding = NamedSharding(mesh, P("dp", None))
+    repl = NamedSharding(mesh, P())
+
+    def init_sharded(key):
+        st = jax.jit(init_state, out_shardings=repl)(key)
+        return st
+
+    step = jax.jit(step_body,
+                   in_shardings=(repl, tok_sharding),
+                   out_shardings=(repl, repl))
+    return init_sharded, step
