@@ -34,7 +34,7 @@ from .vit import (ViTConfig, forward_vit, init_vit_params,
 from .speculative import generate_lookahead
 from .ssm import (SsmConfig, init_ssm_params, init_ssm_state,
                   make_ssm_train_step, ssm_decode, ssm_forward,
-                  ssm_step)
+                  ssm_forward_sp, ssm_prefill, ssm_step)
 from .pipeline_lm import (
     forward_pipelined,
     init_pipelined_params,
@@ -50,6 +50,8 @@ __all__ = [
     "make_ssm_train_step",
     "ssm_decode",
     "ssm_forward",
+    "ssm_forward_sp",
+    "ssm_prefill",
     "ssm_step",
     "QTensor",
     "quantize",

@@ -39,8 +39,8 @@ from .transformer import (_dense_init, _layernorm, make_optimizer,
                           token_xent)
 
 __all__ = ["SsmConfig", "init_ssm_params", "ssm_forward",
-           "make_ssm_train_step", "ssm_decode", "init_ssm_state",
-           "ssm_step"]
+           "ssm_forward_sp", "make_ssm_train_step", "ssm_decode",
+           "ssm_prefill", "init_ssm_state", "ssm_step"]
 
 
 @dataclass(frozen=True)
@@ -77,7 +77,10 @@ def init_ssm_params(cfg: SsmConfig, key: jax.Array) -> Dict[str, Any]:
         mod = jnp.sqrt(u1 * (cfg.r_max ** 2 - cfg.r_min ** 2)
                        + cfg.r_min ** 2)
         nu_log = jnp.log(-jnp.log(mod))
-        theta_log = jnp.log(_uniform(ks[1], (h,), 0.0, math.pi))
+        # Lower bound keeps log() finite: uniform's minval is
+        # INCLUSIVE, and a 0.0 draw would put -inf in theta_log, which
+        # AdamW's weight decay turns into nan on the first update.
+        theta_log = jnp.log(_uniform(ks[1], (h,), 1e-6, math.pi))
         blocks.append({
             "nu_log": nu_log,
             "theta_log": theta_log,
@@ -111,12 +114,17 @@ def _lam_gam(blk) -> Tuple[jax.Array, jax.Array]:
     return lam, gam
 
 
-def _lru_scan(blk, u: jax.Array) -> jax.Array:
+def _lru_scan(blk, u: jax.Array, scan_fn=None, with_state=False):
     """The recurrence over a full sequence: u (b, s, d) -> y (b, s, d).
 
-    ``associative_scan`` over the first-order linear-recurrence monoid
-    ``(a2, b2) . (a1, b1) = (a2*a1, a2*b1 + b2)`` — O(log s) depth, no
-    serial loop, exactly the sequential recurrence's values."""
+    ``scan_fn(a, b)`` computes the inclusive linear scan along axis 1
+    (default: the single-device ``parallel.scan.linear_scan``; the
+    sequence-parallel forward passes ``sharded_linear_scan`` instead —
+    same monoid, sequence sharded over a mesh axis). ``with_state``
+    additionally returns the final recurrent state x_{s-1} (b, h) —
+    what a decode loop continues from."""
+    from ..parallel.scan import linear_scan
+
     lam, gam = _lam_gam(blk)
     # Drive term in complex64: (b, s, h)
     drive = jnp.einsum("bsd,dh->bsh", u.astype(jnp.float32),
@@ -124,39 +132,67 @@ def _lru_scan(blk, u: jax.Array) -> jax.Array:
         "bsd,dh->bsh", u.astype(jnp.float32), blk["b_im"])
     drive = gam[None, None] * drive.astype(jnp.complex64)
     a = jnp.broadcast_to(lam[None, None], drive.shape)
-
-    def combine(left, right):
-        a1, b1 = left
-        a2, b2 = right
-        return a2 * a1, a2 * b1 + b2
-
-    _, x = lax.associative_scan(combine, (a, drive), axis=1)
+    if scan_fn is None:
+        x = linear_scan(a, drive, axis=1)
+    else:
+        x = scan_fn(a, drive)
     y = (jnp.einsum("bsh,hd->bsd", x.real, blk["c_re"])
          - jnp.einsum("bsh,hd->bsd", x.imag, blk["c_im"]))
-    return y.astype(u.dtype) + blk["d_skip"].astype(u.dtype) * u
+    y = y.astype(u.dtype) + blk["d_skip"].astype(u.dtype) * u
+    if with_state:
+        return y, x[:, -1]
+    return y
 
 
-def _block(blk, x: jax.Array) -> jax.Array:
+def _block(blk, x: jax.Array, scan_fn=None, with_state=False):
     h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
                    blk["ln1"]["bias"].astype(x.dtype))
-    x = x + _lru_scan(blk, h)
+    if with_state:
+        y, s_last = _lru_scan(blk, h, scan_fn, with_state=True)
+    else:
+        y, s_last = _lru_scan(blk, h, scan_fn), None
+    x = x + y
     h = _layernorm(x, blk["ln2"]["scale"].astype(x.dtype),
                    blk["ln2"]["bias"].astype(x.dtype))
     h = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h,
                                blk["w1"].astype(x.dtype)))
-    return x + jnp.einsum("bsf,fd->bsd", h, blk["w2"].astype(x.dtype))
+    x = x + jnp.einsum("bsf,fd->bsd", h, blk["w2"].astype(x.dtype))
+    return (x, s_last) if with_state else x
+
+
+def _forward_impl(cfg: SsmConfig, params: Dict[str, Any],
+                  tokens: jax.Array, scan_fn=None) -> jax.Array:
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    for blk in params["blocks"]:
+        x = _block(blk, x, scan_fn)
+    x = _layernorm(x, params["ln_f"]["scale"].astype(x.dtype),
+                   params["ln_f"]["bias"].astype(x.dtype))
+    return jnp.einsum("bsd,dv->bsv", x, params["head"].astype(x.dtype))
 
 
 def ssm_forward(cfg: SsmConfig, params: Dict[str, Any],
                 tokens: jax.Array) -> jax.Array:
     """tokens (b, s) int32 -> logits (b, s, vocab). Strictly causal:
     position t sees tokens[:, :t+1] only (the recurrence is the proof)."""
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    for blk in params["blocks"]:
-        x = _block(blk, x)
-    x = _layernorm(x, params["ln_f"]["scale"].astype(x.dtype),
-                   params["ln_f"]["bias"].astype(x.dtype))
-    return jnp.einsum("bsd,dv->bsv", x, params["head"].astype(x.dtype))
+    return _forward_impl(cfg, params, tokens)
+
+
+def ssm_forward_sp(cfg: SsmConfig, params: Dict[str, Any],
+                   tokens: jax.Array,
+                   axis_name: str = "sp") -> jax.Array:
+    """Sequence-parallel forward — call inside ``shard_map`` with
+    ``tokens`` (b, s_local) holding this rank's contiguous chunk of
+    the sequence (sharded over ``axis_name``) and params replicated.
+    Every per-position op stays local; only the recurrence crosses
+    devices, via :func:`mpi_tpu.parallel.sharded_linear_scan`'s
+    O(log n) carry exchange — the SSM's ring-attention analogue, for
+    sequences longer than one device's memory."""
+    from ..parallel.scan import sharded_linear_scan
+
+    return _forward_impl(
+        cfg, params, tokens,
+        scan_fn=lambda a, b: sharded_linear_scan(a, b, axis_name,
+                                                 axis=1))
 
 
 # -- recurrent decode (O(1) per token; the KV-cache-free serving story) --
@@ -198,25 +234,41 @@ def ssm_step(cfg: SsmConfig, params: Dict[str, Any], state: list,
                                  params["head"].astype(x.dtype))
 
 
+def ssm_prefill(cfg: SsmConfig, params: Dict[str, Any],
+                prompt: jax.Array):
+    """(per-layer recurrent state after the last prompt token,
+    last-position logits (b, vocab)) in ONE parallel-scan forward —
+    O(log p) depth instead of p serial steps, and no (p, vocab) logits
+    ever materialize (only the last position projects to the head)."""
+    x = params["embed"].astype(cfg.dtype)[prompt]
+    states = []
+    for blk in params["blocks"]:
+        x, s_last = _block(blk, x, with_state=True)
+        states.append(s_last)
+    xl = _layernorm(x[:, -1], params["ln_f"]["scale"].astype(x.dtype),
+                    params["ln_f"]["bias"].astype(x.dtype))
+    return states, jnp.einsum("bd,dv->bv", xl,
+                              params["head"].astype(x.dtype))
+
+
 @partial(jax.jit, static_argnums=(0, 3))
 def ssm_decode(cfg: SsmConfig, params: Dict[str, Any],
                prompt: jax.Array, n_new: int) -> jax.Array:
     """Greedy decode: prompt (b, p) int32 -> (b, p + n_new), one jitted
-    program (prefill scan + generate scan) carrying the O(1) recurrent
-    state — decode cost per token is independent of how much context
-    came before (the structural advantage over KV-cache attention)."""
+    program (parallel prefill + generate scan) carrying the O(1)
+    recurrent state — decode cost per token is independent of how much
+    context came before (the structural advantage over KV-cache
+    attention), and the prefill is the O(log p) scan, not p serial
+    steps."""
     b, p = prompt.shape
     if n_new <= 0 or p == 0:
-        # p == 0 would make the prefill scan's last-logits read
-        # undefined; unconditional generation starts from a BOS-style
-        # prompt of at least one token.
+        # p == 0 would make the prefill's last-logits read undefined;
+        # unconditional generation starts from a BOS-style prompt of
+        # at least one token.
         return prompt
 
-    state = init_ssm_state(cfg, b)
-    state, logits = lax.scan(
-        lambda st, t: ssm_step(cfg, params, st, t), state,
-        jnp.transpose(prompt, (1, 0)))
-    first = jnp.argmax(logits[-1], axis=-1).astype(prompt.dtype)
+    state, logits_last = ssm_prefill(cfg, params, prompt)
+    first = jnp.argmax(logits_last, axis=-1).astype(prompt.dtype)
 
     def step(carry, _):
         st, tok = carry

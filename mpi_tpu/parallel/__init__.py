@@ -19,6 +19,7 @@ from .collectives import (
     ring_reduce_scatter,
     tree_allreduce,
 )
+from .scan import linear_scan, sharded_linear_scan
 from .ring_attention import (
     ring_attention,
     ring_flash_attention,
@@ -73,7 +74,9 @@ __all__ = [
     "hierarchical_allreduce",
     "pshift",
     "reduce_scatter",
+    "linear_scan",
     "ring_allreduce",
     "ring_reduce_scatter",
+    "sharded_linear_scan",
     "tree_allreduce",
 ]

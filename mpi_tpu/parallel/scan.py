@@ -1,0 +1,88 @@
+"""Distributed first-order linear scan — sequence parallelism for
+recurrences (the SSM twin of ring attention).
+
+``x_t = a_t * x_{t-1} + b_t`` over a sequence SHARDED across a mesh
+axis: each device scans its local chunk (``lax.associative_scan``,
+O(log s_local) depth), the per-chunk summaries exscan across ranks in
+O(log n) ``ppermute`` rounds (Hillis-Steele over the same monoid), and
+one elementwise combine folds the incoming carry in — total depth
+O(log s_local + log n), bit-for-bit the single-device scan's
+contraction order within each chunk. This is what lets the LRU/SSM
+family (:mod:`mpi_tpu.models.ssm`) train on sequences longer than one
+device's memory, the way ring attention does for Transformers.
+
+Monoid: ``(a2, b2) ∘ (a1, b1) = (a2*a1, a2*b1 + b2)`` — left operand
+is the EARLIER segment, matching ``lax.associative_scan``'s
+left-to-right convention and the generic layer's prefix fold.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from .collectives import pshift
+from .mesh import RANK_AXIS
+
+__all__ = ["sharded_linear_scan", "linear_scan"]
+
+
+def _combine(left: Tuple, right: Tuple) -> Tuple:
+    a1, b1 = left
+    a2, b2 = right
+    return a2 * a1, a2 * b1 + b2
+
+
+def linear_scan(a: jnp.ndarray, b: jnp.ndarray,
+                axis: int = 0) -> jnp.ndarray:
+    """Single-device inclusive scan of ``x_t = a_t x_{t-1} + b_t``
+    along ``axis`` (x_{-1} = 0): the local building block, exposed for
+    reference/testing."""
+    _, x = lax.associative_scan(_combine, (a, b), axis=axis)
+    return x
+
+
+def sharded_linear_scan(a: jnp.ndarray, b: jnp.ndarray,
+                        axis_name: str = RANK_AXIS,
+                        axis: int = 0) -> jnp.ndarray:
+    """Inclusive linear scan along ``axis`` of arrays whose ``axis``
+    dimension is sequence-sharded over mesh axis ``axis_name`` (call
+    inside ``shard_map``; rank r holds positions ``[r*s_local,
+    (r+1)*s_local)``). Returns this rank's chunk of the GLOBAL scan.
+
+    Three phases:
+      1. local inclusive scan of the chunk;
+      2. exscan of the chunk summaries ``(prod a, carry)`` across
+         ranks — Hillis-Steele in O(log n) ppermute hops;
+      3. fold the incoming carry: ``x_t = P_t * carry_in + x_t_local``
+         where ``P_t`` is the chunk-local prefix product of ``a``.
+    """
+    n = lax.axis_size(axis_name)
+    # Phase 1: local scan keeps both monoid components (P_t, X_t).
+    prods, xs = lax.associative_scan(_combine, (a, b), axis=axis)
+    if n == 1:
+        return xs
+    idx = lax.axis_index(axis_name)
+    # Chunk summary = last element of the local scan.
+    last = lambda arr: lax.index_in_dim(  # noqa: E731
+        arr, arr.shape[axis] - 1, axis=axis, keepdims=False)
+    acc_a, acc_b = last(prods), last(xs)
+
+    # Phase 2: Hillis-Steele INCLUSIVE scan over ranks, then shift
+    # right one rank for the exclusive carry (identity into rank 0).
+    d = 1
+    while d < n:
+        in_a = pshift(acc_a, d, axis_name)
+        in_b = pshift(acc_b, d, axis_name)
+        take = idx >= d
+        new_a, new_b = _combine((in_a, in_b), (acc_a, acc_b))
+        acc_a = jnp.where(take, new_a, acc_a)
+        acc_b = jnp.where(take, new_b, acc_b)
+        d *= 2
+    carry_in = pshift(acc_b, 1, axis_name)
+    carry_in = jnp.where(idx == 0, jnp.zeros_like(carry_in), carry_in)
+
+    # Phase 3: x_t_global = P_t * carry_in + x_t_local.
+    return prods * jnp.expand_dims(carry_in, axis) + xs

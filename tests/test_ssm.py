@@ -126,3 +126,112 @@ class TestDecode:
         prompt = jnp.zeros((2, 0), jnp.int32)
         out = ssm_decode(CFG, params, prompt, 5)
         assert out.shape == (2, 0)
+
+
+class TestSequenceParallel:
+    """The distributed linear scan and the sp forward built on it."""
+
+    def test_sharded_scan_matches_local(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_tpu.parallel import (linear_scan, make_mesh,
+                                      sharded_linear_scan)
+
+        n = 8
+        rng = np.random.default_rng(23)
+        # Decaying coefficients (|a| < 1) like the LRU's lam.
+        a = jnp.asarray(rng.uniform(0.5, 0.99, (3, n * 16, 5)),
+                        jnp.float32)
+        b = jnp.asarray(rng.standard_normal((3, n * 16, 5)),
+                        jnp.float32)
+        want = linear_scan(a, b, axis=1)
+        mesh = make_mesh(n, axis="sp")
+        body = jax.shard_map(
+            lambda av, bv: sharded_linear_scan(av, bv, "sp", axis=1),
+            mesh=mesh, in_specs=(P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp"), check_vma=False)
+        got = jax.jit(body)(a, b)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_sharded_scan_complex_and_single_rank(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_tpu.parallel import (linear_scan, make_mesh,
+                                      sharded_linear_scan)
+
+        rng = np.random.default_rng(29)
+        a = jnp.asarray(
+            rng.uniform(0.6, 0.95, (2, 12)) * np.exp(
+                1j * rng.uniform(0, 3, (2, 12))), jnp.complex64)
+        b = jnp.asarray(rng.standard_normal((2, 12))
+                        + 1j * rng.standard_normal((2, 12)),
+                        jnp.complex64)
+        want = linear_scan(a, b, axis=1)
+        mesh = make_mesh(4, axis="sp")
+        body = jax.shard_map(
+            lambda av, bv: sharded_linear_scan(av, bv, "sp", axis=1),
+            mesh=mesh, in_specs=(P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp"), check_vma=False)
+        got = jax.jit(body)(a, b)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-5, atol=3e-5)
+
+    def test_ssm_forward_sp_matches_unsharded(self, params):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_tpu.models import ssm_forward_sp
+        from mpi_tpu.parallel import make_mesh
+
+        n = 4
+        toks = _tokens(2, n * 8, seed=31)
+        want = ssm_forward(CFG, params, toks)
+        mesh = make_mesh(n, axis="sp")
+        body = jax.shard_map(
+            lambda t: ssm_forward_sp(CFG, params, t, "sp"),
+            mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+            check_vma=False)
+        got = jax.jit(body)(toks)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-3, atol=3e-3)
+
+    def test_single_rank_sharded_scan_is_local_scan(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_tpu.parallel import (linear_scan, make_mesh,
+                                      sharded_linear_scan)
+
+        rng = np.random.default_rng(37)
+        a = jnp.asarray(rng.uniform(0.5, 0.9, (2, 10)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((2, 10)), jnp.float32)
+        mesh = make_mesh(1, axis="sp")
+        body = jax.shard_map(
+            lambda av, bv: sharded_linear_scan(av, bv, "sp", axis=1),
+            mesh=mesh, in_specs=(P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp"), check_vma=False)
+        np.testing.assert_allclose(
+            np.asarray(jax.jit(body)(a, b)),
+            np.asarray(linear_scan(a, b, axis=1)), rtol=1e-6)
+
+
+class TestPrefill:
+    def test_prefill_state_matches_sequential_steps(self, params):
+        """The O(log p) parallel prefill must land on the same
+        recurrent state and last logits as p sequential ssm_steps."""
+        from mpi_tpu.models import ssm_prefill
+
+        toks = _tokens(2, 11, seed=41)
+        state_p, logits_p = ssm_prefill(CFG, params, toks)
+        state_s = init_ssm_state(CFG, 2)
+        for i in range(11):
+            state_s, lg = ssm_step(CFG, params, state_s, toks[:, i])
+        for sp, ss in zip(state_p, state_s):
+            np.testing.assert_allclose(np.asarray(sp), np.asarray(ss),
+                                       rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(logits_p),
+                                   np.asarray(lg),
+                                   rtol=2e-3, atol=2e-3)
