@@ -101,3 +101,14 @@ class TestMpi4pyPort:
         out = res.stdout
         assert out.count("mpi4py surface OK") == 4
         assert "pi=3.141593" in out
+
+
+@pytest.mark.integration
+class TestSsmExample:
+    def test_ssm_example_runs(self):
+        res = subprocess.run(
+            [sys.executable, "examples/ssm.py", "--devices", "2",
+             "--steps", "120"],
+            capture_output=True, text=True, timeout=420, cwd=REPO)
+        assert res.returncode == 0, res.stderr[-800:] + res.stdout[-400:]
+        assert "ssm example OK" in res.stdout
