@@ -134,6 +134,19 @@ def _median_time(fn, reps: int = 3):
     return statistics.median(ts)
 
 
+def _differenced(run_short, run_long, n_short: int, n_long: int):
+    """(per_unit_seconds, timing_method): difference a long- and a
+    short-program timing so fixed dispatch/tunnel latency cancels; on
+    timing noise (non-positive delta) fall back to total/n and SAY SO
+    — the shared scaffold of every train/decode-style leg."""
+    t_short = _median_time(run_short)
+    t_long = _median_time(run_long)
+    per_unit = (t_long - t_short) / (n_long - n_short)
+    if per_unit <= 0:
+        return t_long / n_long, "fallback_total_over_n"
+    return per_unit, "differenced"
+
+
 def measure_train_step(d_model: int = 1024, n_layers: int = 8,
                        n_heads: int = 8, d_ff: int = 4096,
                        vocab: int = 8192, batch: int = 8,
@@ -220,14 +233,9 @@ def measure_train_step(d_model: int = 1024, n_layers: int = 8,
     if not math.isfinite(loss_v):
         raise RuntimeError(f"bench train step diverged: loss={loss_v}")
 
-    t_short = _median_time(lambda: float(run_short(state)[1]))
-    t_long = _median_time(lambda: float(run_long(state)[1]))
-    per_step = (t_long - t_short) / (long - short)
-    timing_method = "differenced"
-    if per_step <= 0:  # timing noise swamped the delta; fall back —
-        # flagged, because this folds the fixed host-sync latency back in
-        per_step = t_long / long
-        timing_method = "fallback_total_over_n"
+    per_step, timing_method = _differenced(
+        lambda: float(run_short(state)[1]),
+        lambda: float(run_long(state)[1]), short, long)
 
     flops = train_flops_per_step(cfg, batch, seq)
     dev = jax.devices()[0]
@@ -319,13 +327,9 @@ def measure_decode(d_model: int = 1024, n_layers: int = 8, n_heads: int = 8,
 
     run_short, run_long = run(short), run(long)
     int(run_short(prompt)); int(run_long(prompt))  # compile + warm
-    t_short = _median_time(lambda: int(run_short(prompt)))
-    t_long = _median_time(lambda: int(run_long(prompt)))
-    per_tok = (t_long - t_short) / (long - short)
-    timing_method = "differenced"
-    if per_tok <= 0:
-        per_tok = t_long / long
-        timing_method = "fallback_total_over_n"
+    per_tok, timing_method = _differenced(
+        lambda: int(run_short(prompt)),
+        lambda: int(run_long(prompt)), short, long)
     sfx = "_int8" if int8 else ""
     return {
         f"decode{sfx}_ms_per_token": round(per_tok * 1e3, 3),
@@ -346,6 +350,79 @@ def _size_label(size_bytes: int) -> str:
     if size_bytes >= 1 << 10 and size_bytes % (1 << 10) == 0:
         return f"{size_bytes >> 10}KiB"
     return f"{size_bytes}B"
+
+
+def measure_ssm(d_model: int = 1024, n_layers: int = 8,
+                d_state: int = 256, d_ff: int = 4096, vocab: int = 8192,
+                batch: int = 8, seq: int = 1024, prompt_len: int = 128,
+                short: int = 16, long: int = 128,
+                train_short: int = 2, train_long: int = 6) -> dict:
+    """The state-space LM at flagship scale: train-step time (the
+    associative-scan recurrence instead of attention) and greedy decode
+    tokens/s (O(1) recurrent state — per-token cost independent of
+    context, the structural contrast with the KV-cache decode leg).
+    Same differenced-scan timing as every other leg."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from mpi_tpu.models import (SsmConfig, make_ssm_train_step,
+                                ssm_decode)
+
+    cfg = SsmConfig(vocab=vocab, d_model=d_model, n_layers=n_layers,
+                    d_state=d_state, d_ff=d_ff,
+                    dtype=jnp.bfloat16
+                    if jax.default_backend() == "tpu" else jnp.float32)
+    init_state, step_body = make_ssm_train_step(cfg)
+    state = init_state(jax.random.PRNGKey(0))
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, vocab, (batch, seq + 1)),
+        jnp.int32)
+
+    def steps(k):
+        @jax.jit
+        def run(st):
+            st, losses = lax.scan(lambda s, _: step_body(s, toks),
+                                  st, None, length=k)
+            return st, losses[-1]
+        return run
+
+    rs, rl = steps(train_short), steps(train_long)
+    loss_v = float(rs(state)[1])  # compile + warm
+    float(rl(state)[1])
+    if not math.isfinite(loss_v):
+        raise RuntimeError(f"bench ssm train step diverged: "
+                           f"loss={loss_v}")
+    per_step, train_method = _differenced(
+        lambda: float(rs(state)[1]), lambda: float(rl(state)[1]),
+        train_short, train_long)
+
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(0, vocab, (batch, prompt_len)),
+        jnp.int32)
+    params = state["params"]
+
+    def dec(k):
+        return jax.jit(lambda p: ssm_decode(cfg, params, p, k)
+                       [:, -1].sum())
+
+    ds, dl = dec(short), dec(long)
+    int(ds(prompt)); int(dl(prompt))  # compile + warm
+    per_tok, dec_method = _differenced(
+        lambda: int(ds(prompt)), lambda: int(dl(prompt)), short, long)
+    return {
+        "ssm_train_step_ms": round(per_step * 1e3, 3),
+        "ssm_train_tokens_per_s": round(batch * seq / per_step),
+        "ssm_train_timing_method": train_method,
+        "ssm_decode_ms_per_token": round(per_tok * 1e3, 3),
+        "ssm_decode_tokens_per_s": round(batch / per_tok),
+        "ssm_decode_timing_method": dec_method,
+        "ssm_loss_first_step": round(loss_v, 4),
+        "ssm_model": {"d_model": d_model, "n_layers": n_layers,
+                      "d_state": d_state, "d_ff": d_ff, "vocab": vocab,
+                      "batch": batch, "seq": seq},
+    }
 
 
 def measure_allreduce(size_bytes: int = 256 << 20, chain: int = 5,
@@ -774,6 +851,9 @@ _SMOKE_LONGCTX = dict(seq=128, d_model=64, n_heads=4, n_layers=2,
                       d_ff=128, vocab=128, short=1, long=3)
 _SMOKE_DECODE = dict(d_model=64, n_layers=2, n_heads=4, d_ff=128,
                      vocab=128, batch=2, prompt_len=16, short=4, long=12)
+_SMOKE_SSM = dict(d_model=48, n_layers=1, d_state=16, d_ff=96,
+                  vocab=128, batch=2, seq=32, prompt_len=4, short=2,
+                  long=5, train_short=1, train_long=2)
 
 
 def _device_leg_impl(name: str, smoke: bool) -> dict:
@@ -788,6 +868,8 @@ def _device_leg_impl(name: str, smoke: bool) -> dict:
     if name == "decode_int8":
         return measure_decode(int8=True,
                               **(_SMOKE_DECODE if smoke else {}))
+    if name == "ssm":
+        return measure_ssm(**(_SMOKE_SSM if smoke else {}))
     if name == "allreduce":
         ar_size = (1 << 20) if smoke else (256 << 20)
         curve_sizes = [1 << 10, 32 << 10, 1 << 20]
@@ -1088,11 +1170,11 @@ def main() -> int:
     # 1 MiB) in the DEFAULT line — the driver never passes --suite.
     leg_platform = platform_arg or ("cpu:1" if tpu_fallback else None)
     budgets = {"train": 900.0, "long_ctx": 700.0, "decode": 420.0,
-               "decode_int8": 420.0, "allreduce": 700.0}
+               "decode_int8": 420.0, "allreduce": 700.0, "ssm": 500.0}
     if smoke:
         budgets = {k: min(v, 200.0) for k, v in budgets.items()}
     for leg_name in ("train", "long_ctx", "decode", "decode_int8",
-                     "allreduce"):
+                     "allreduce", "ssm"):
         if deadline_end is not None:
             remaining = deadline_end - time.monotonic() - 120.0
             if remaining < 45.0:

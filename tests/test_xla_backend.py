@@ -454,7 +454,7 @@ def test_bench_harness_emits_json_line():
     proc = subprocess.run(
         [sys.executable, str(root / "bench.py"), "--platform", "cpu",
          "--smoke"],
-        capture_output=True, text=True, timeout=240, cwd=root)
+        capture_output=True, text=True, timeout=420, cwd=root)
     assert proc.returncode == 0, proc.stderr
     line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
     rec = json.loads(line)
