@@ -72,8 +72,17 @@ _NEIGHBOR_SLICE = 1 << 20
 # RMA window passive-target service traffic owns the slice directly
 # below the neighborhood slice (two tags per window: requests +
 # replies; see window._svc_tags). Same fencing rule: the generic
-# collective sequence is capped below both slices.
+# collective sequence is capped below both slices. WIN_TAG_BASE is the
+# slice's first tag — the ONE definition window.py and the hybrid
+# driver's cross-host remap both build on.
 _WIN_SLICE = 1 << 20
+
+
+def _win_tag_base() -> int:
+    from .collectives_generic import COLL_TAG_BASE
+
+    return COLL_TAG_BASE + (CTX_SPAN - USER_TAG_SPAN - _NEIGHBOR_SLICE
+                            - _WIN_SLICE)
 # Context numbering: negotiated contexts grow monotonically from 1 and
 # can never plausibly reach the top of the space, so the topmost
 # _CREATE_GROUP_TAGS contexts are reserved as create_group's bootstrap

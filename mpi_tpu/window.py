@@ -60,12 +60,14 @@ _win_alloc_lock = threading.Lock()
 def _svc_tags(comm: Comm, wid: int) -> Tuple[int, int]:
     """(request, reply) tags for window ``wid``'s passive-target
     service, carved from the reserved window slice directly below the
-    neighborhood slice (comm.py tag layout)."""
+    neighborhood slice (comm.py tag layout; the hybrid driver's
+    cross-host remap shares the same _win_tag_base)."""
+    from .comm import _win_tag_base
+
     if wid * 2 + 1 >= _WIN_SLICE:
         raise MpiError(
             f"mpi_tpu: window id space exhausted (wid={wid})")
-    base = COLL_TAG_BASE + (CTX_SPAN - USER_TAG_SPAN - _NEIGHBOR_SLICE
-                            - _WIN_SLICE) + wid * 2
+    base = _win_tag_base() + wid * 2
     return base, base + 1
 
 

@@ -44,6 +44,7 @@ def _schedule(seed: int):
             "gather_scatter", "group_allreduce", "iallreduce",
             "rma_epoch", "probe_pass", "fetch_ticket",
             "receive_any_star", "intercomm_xreduce", "pack_ring",
+            "passive_lock", "passive_ticket",
         ])
         ops.append((kind, int(rng.integers(0, 1 << 30)),
                     int(rng.integers(0, N)),
@@ -58,6 +59,11 @@ def _run_schedule(comm, rank: int, seed: int):
     log = []
     n = comm.size()
     win = mpi_tpu.win_create(comm, np.zeros(n, np.int64))
+    # Passive-target window (lock/unlock service threads live for the
+    # whole schedule; slot 0 = locked-increment cell, slot 1 = ticket
+    # counter). Modified ONLY by the passive kinds, so post-barrier
+    # values are deterministic even though interleavings are not.
+    pwin = mpi_tpu.win_create(comm, np.zeros(2, np.int64), locks=True)
     for step, (kind, salt, root, op) in enumerate(_schedule(seed)):
         base = np.int64(salt % 1000 + rank * 7 + step)
         if kind == "allreduce":
@@ -150,6 +156,30 @@ def _run_schedule(comm, rank: int, seed: int):
             merged.free()
             inter.free()
             local.free()
+        elif kind == "passive_lock":
+            # Exclusive-locked read-modify-write on the step's root:
+            # racing increments whose TOTAL is deterministic. The
+            # trailing barrier keeps a fast rank's NEXT passive step
+            # from landing on this window before the read below.
+            for _ in range(2):
+                pwin.lock(root)
+                cur = int(pwin.get(root, 0, 1).array[0])
+                pwin.put(np.int64([cur + rank + 1]), root, 0)
+                pwin.unlock(root)
+            comm.barrier()
+            log.append(int(pwin.local[0]))
+            comm.barrier()
+        elif kind == "passive_ticket":
+            pwin.lock(root)
+            pre = int(pwin.fetch_and_op(np.int64(1), root,
+                                        offset=1).array[0])
+            pwin.unlock(root)
+            comm.barrier()
+            # Ticket values arrive in nondeterministic order; the
+            # SORTED set (a contiguous run) and the counter are not.
+            log.append(sorted(int(t) for t in comm.allgather(pre)))
+            log.append(int(pwin.local[1]))
+            comm.barrier()  # reads settle before the next step's ops
         elif kind == "pack_ring":
             # MPI_Pack payloads through the sendrecv ring: codec-level
             # framing must survive every transport identically.
@@ -159,6 +189,8 @@ def _run_schedule(comm, rank: int, seed: int):
                                 source=(rank - 1) % n, tag=400 + step)
             a, b, c = mpi_tpu.unpack(bytes(got))
             log.append([int(a), b, [int(x) for x in c]])
+    comm.barrier()  # no in-flight passive requests across the frees
+    pwin.free()
     win.free()
     return log
 
