@@ -352,6 +352,10 @@ class Comm:
                 return src
         return None
 
+    # mpi4py exposes both spellings (probe == Probe etc.).
+    Probe = probe
+    Iprobe = iprobe
+
     # -- buffer-based p2p (uppercase: numpy arrays, no repickling) ----------
     #
     # ``buf`` is a bare array or an mpi4py buffer spec ``[buf, count,
@@ -404,6 +408,22 @@ class Comm:
                     status.count = _payload_count(np.asarray(got))
                 return got
         return _FillOnWaitRequest(inner, _wait_fill_any)
+
+    def Sendrecv_replace(self, buf: Any, dest: int, sendtag: int = 0,
+                         source: int = -1,
+                         recvtag: Optional[int] = None,
+                         status: Optional[Status] = None) -> None:
+        """Buffer sendrecv where ONE buffer (or buffer spec) is both
+        the outgoing data and the landing zone
+        (MPI_Sendrecv_replace): the payload is snapshotted before the
+        exchange, so overlap is safe."""
+        _RecvTarget(buf, "Sendrecv_replace")  # validate before moving
+        # ONE snapshot copy: _spec_payload may return the caller's own
+        # contiguous buffer, which the receive below writes through.
+        payload = _spec_payload(buf, "Sendrecv_replace").copy()
+        self.Sendrecv(payload, dest, sendtag,
+                      recvbuf=buf, source=source, recvtag=recvtag,
+                      status=status)
 
     def Sendrecv(self, sendbuf: Any, dest: int, sendtag: int = 0,
                  recvbuf: Any = None, source: int = -1,
@@ -1328,6 +1348,17 @@ class Op:
 
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return f"MPI.{self.name.upper()}"
+
+    def Reduce_local(self, inbuf: Any, inoutbuf: Any) -> None:
+        """``inoutbuf = inbuf op inoutbuf`` elementwise, locally
+        (MPI_Reduce_local) — the user-side combine step, sharing the
+        exact arithmetic every driver reduces with."""
+        from .collectives_generic import combine
+
+        out = _writable_buffer(inoutbuf, "Reduce_local")
+        np.copyto(out, np.asarray(
+            combine(np.ascontiguousarray(inbuf), out, self.name)
+        ).reshape(out.shape))
 
 
 def _op(op: Optional[Op]) -> Any:

@@ -48,9 +48,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .api import MpiError
-from .collectives_generic import COLL_TAG_BASE, OpLike, combine
-from .comm import CTX_SPAN, USER_TAG_SPAN, Comm
-from .comm import _NEIGHBOR_SLICE, _WIN_SLICE
+from .collectives_generic import OpLike, combine
+from .comm import Comm, _WIN_SLICE, _win_tag_base
 
 __all__ = ["Window", "win_create"]
 
@@ -62,8 +61,6 @@ def _svc_tags(comm: Comm, wid: int) -> Tuple[int, int]:
     service, carved from the reserved window slice directly below the
     neighborhood slice (comm.py tag layout; the hybrid driver's
     cross-host remap shares the same _win_tag_base)."""
-    from .comm import _win_tag_base
-
     if wid * 2 + 1 >= _WIN_SLICE:
         raise MpiError(
             f"mpi_tpu: window id space exhausted (wid={wid})")

@@ -1285,3 +1285,56 @@ class TestCommSelfAttrsVersion:
 
         res = run_spmd(main, n=2)
         assert all(res)
+
+
+class TestSmallSurface:
+    def test_sendrecv_replace_ring(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            buf = np.full(3, float(r))
+            comm.Sendrecv_replace(buf, dest=(r + 1) % n,
+                                  source=(r - 1) % n)
+            MPI.Finalize()
+            return buf.tolist()
+
+        res = run_spmd(main, n=3)
+        for r, got in enumerate(res):
+            assert got == [float((r - 1) % 3)] * 3
+
+    def test_reduce_local_and_probe_aliases(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            acc = np.asarray([10.0, 20.0])
+            MPI.SUM.Reduce_local(np.asarray([1.0, 2.0]), acc)
+            MPI.MAX.Reduce_local(np.asarray([100.0, 0.0]), acc)
+            if r == 0:
+                comm.send("ping", dest=1, tag=9)
+                out = None
+            else:
+                st = MPI.Status()
+                comm.Probe(source=0, tag=9, status=st)
+                hit = comm.Iprobe(source=0, tag=9)
+                got = comm.recv(source=0, tag=9)
+                out = (st.Get_source(), hit, got)
+            MPI.Finalize()
+            return acc.tolist(), out
+
+        res = run_spmd(main, n=2)
+        for acc, _ in res:
+            assert acc == [100.0, 22.0]
+        assert res[1][1] == (0, True, "ping")
+
+    def test_sendrecv_replace_with_spec(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            buf = np.full(4, float(r))
+            comm.Sendrecv_replace([buf, 4, MPI.DOUBLE],
+                                  dest=(r + 1) % n, source=(r - 1) % n)
+            MPI.Finalize()
+            return buf.tolist()
+
+        res = run_spmd(main, n=2)
+        assert res[0] == [1.0] * 4 and res[1] == [0.0] * 4
