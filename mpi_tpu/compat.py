@@ -1202,6 +1202,44 @@ class Win:
         for handle, out in pending:
             np.copyto(out, handle.array.reshape(out.shape))
 
+    # -- PSCW (MPI_Win_post/start/complete/wait) ----------------------------
+
+    def _group_ranks(self, group) -> set:
+        """Window-comm ranks for a PSCW group. An ``MPI.Group``
+        identifies PROCESSES (its ranks number in its parent comm, as
+        in mpi4py), so each member is translated parent-rank → world
+        rank → this window's comm rank; a plain iterable of ints is
+        taken as window-comm ranks directly."""
+        wmembers = self._w.comm.members
+        if isinstance(group, Group):
+            out = set()
+            for g in group._ranks:
+                world = group._parent._c.translate(g)
+                try:
+                    out.add(wmembers.index(world))
+                except ValueError:
+                    raise api.MpiError(
+                        f"mpi_tpu.compat: PSCW group member (world "
+                        f"rank {world}) is not in the window's "
+                        f"communicator") from None
+            return out
+        return {int(r) for r in group}
+
+    def Post(self, group, assertion: int = 0) -> None:
+        """Open an exposure epoch to ``group`` (an ``MPI.Group`` or an
+        iterable of window-comm ranks); needs
+        ``info={"locks": "true"}``."""
+        self._w.post(self._group_ranks(group))
+
+    def Start(self, group, assertion: int = 0) -> None:
+        self._w.start(self._group_ranks(group))
+
+    def Complete(self) -> None:
+        self._w.complete()
+
+    def Wait(self) -> None:
+        self._w.wait()
+
     # -- passive target (MPI_Win_lock/unlock) -------------------------------
 
     def Lock(self, rank: int, lock_type: Optional[int] = None,

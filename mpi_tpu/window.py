@@ -126,6 +126,9 @@ class Window:
         # starts only on fully-constructed windows).
         self._locks_enabled = bool(locks)
         self._held: Dict[int, str] = {}      # target -> "excl"/"shared"
+        self._access: set = set()            # PSCW access epoch targets
+        self._access_open = False
+        self._exposure_open = False
         self._origin_lock = threading.Lock()  # serialize my requests
         self._svc_thread: Optional[threading.Thread] = None
         self._svc_stop = False
@@ -173,6 +176,14 @@ class Window:
             self._lk_excl: Optional[int] = None
             self._lk_shared: set = set()
             self._lk_waiters: deque = deque()
+            # PSCW state. _exposure/_completed are shared between the
+            # service thread (post notifications, completes) and
+            # wait(); _posted_from between the service thread and
+            # start() — both under _pscw_cv.
+            self._pscw_cv = threading.Condition()
+            self._exposure: set = set()
+            self._completed: set = set()
+            self._posted_from: set = set()
             self._svc_thread = threading.Thread(
                 target=self._serve, daemon=True,
                 name=f"mpi-win-svc-{wid}")
@@ -235,10 +246,10 @@ class Window:
         validate the span, queue the record for the closing fence."""
         arr = np.array(data, dtype=self._local.dtype, copy=True).reshape(-1)
         self._check_span(target, offset, arr.shape[0])
-        if target in self._held:
-            # Passive epoch: execute synchronously at the target's
-            # service thread (completed on return; flush is trivially
-            # satisfied). The pre-value rides the reply for
+        if target in self._held or target in self._access:
+            # Passive or PSCW epoch: execute synchronously at the
+            # target's service thread (completed on return; flush is
+            # trivially satisfied). The pre-value rides the reply for
             # get_accumulate/fetch_and_op.
             pre = self._svc_request(
                 target, ("apply", int(offset), arr, op,
@@ -301,7 +312,7 @@ class Window:
             count = self._extents[target] - offset
         self._check_span(target, offset, count)
         handle = RmaHandle()
-        if target in self._held:
+        if target in self._held or target in self._access:
             handle._value = np.asarray(
                 self._svc_request(target, ("get", int(offset),
                                            int(count))))
@@ -394,6 +405,101 @@ class Window:
         for r in sorted(self._held):
             self.flush(r)
 
+    # -- PSCW (generalized active target: MPI_Win_post/start/complete/wait)
+
+    def _pscw_group(self, group, what: str) -> set:
+        ranks = {int(r) for r in group}
+        for r in ranks:
+            self._comm._check_peer(r)
+        return ranks  # empty is a valid MPI no-op epoch
+
+    @staticmethod
+    def _pscw_timeout() -> Optional[float]:
+        """PSCW epochs block indefinitely by default (matching the
+        lock path); MPI_TPU_PSCW_TIMEOUT_S sets a debug deadline so a
+        mismatched post/start pairing fails loudly instead of hanging
+        a test run."""
+        import os
+
+        t = float(os.environ.get("MPI_TPU_PSCW_TIMEOUT_S", "0"))
+        return t if t > 0 else None
+
+    def post(self, group) -> None:
+        """Expose this window to the origin ``group`` (MPI_Win_post,
+        nonblocking): their PSCW epoch ops may arrive from now on;
+        :meth:`wait` closes the epoch (an empty group is a valid
+        no-op epoch). Needs ``locks=True`` (the same service engine
+        applies the ops)."""
+        self._require_locks("post")
+        ranks = self._pscw_group(group, "post")
+        with self._pscw_cv:
+            if self._exposure_open:
+                raise MpiError(
+                    "mpi_tpu: Window.post while an exposure epoch is "
+                    "already open (wait() first)")
+            self._exposure_open = True
+            self._exposure = ranks
+            self._completed = set()
+        me = self._comm.rank()
+        for r in sorted(ranks):
+            # One-way notification; the origin's start() collects it.
+            self._comm.send(("posted", me), r, self._svc_tag)
+
+    def start(self, group) -> None:
+        """Open an access epoch to the target ``group`` (MPI_Win_start):
+        blocks until every target has :meth:`post`-ed; RMA to those
+        targets then executes synchronously until :meth:`complete`.
+        An empty group opens a valid no-op epoch."""
+        self._require_locks("start")
+        ranks = self._pscw_group(group, "start")
+        if self._access_open:
+            raise MpiError(
+                "mpi_tpu: Window.start while an access epoch is "
+                "already open (complete() first)")
+        with self._pscw_cv:
+            if not self._pscw_cv.wait_for(
+                    lambda: ranks <= self._posted_from,
+                    timeout=self._pscw_timeout()):
+                raise MpiError(
+                    f"mpi_tpu: Window.start timed out waiting for "
+                    f"post() from {sorted(ranks - self._posted_from)}")
+            self._posted_from -= ranks
+        self._access_open = True
+        self._access = ranks
+
+    def complete(self) -> None:
+        """Close the access epoch (MPI_Win_complete): every op issued
+        since :meth:`start` is already applied (synchronous service);
+        notify each target so its :meth:`wait` can return."""
+        self._require_locks("complete")
+        if not self._access_open:
+            raise MpiError(
+                "mpi_tpu: Window.complete without an open access epoch")
+        for r in sorted(self._access):
+            self._svc_request(r, ("complete",))
+        self._access_open = False
+        self._access = set()
+
+    def wait(self) -> None:
+        """Close the exposure epoch (MPI_Win_wait): blocks until every
+        origin in the posted group has :meth:`complete`-d."""
+        self._require_locks("wait")
+        with self._pscw_cv:
+            if not self._exposure_open:
+                raise MpiError(
+                    "mpi_tpu: Window.wait without an open exposure "
+                    "epoch (post() first)")
+            if not self._pscw_cv.wait_for(
+                    lambda: self._completed >= self._exposure,
+                    timeout=self._pscw_timeout()):
+                raise MpiError(
+                    f"mpi_tpu: Window.wait timed out; missing "
+                    f"complete() from "
+                    f"{sorted(self._exposure - self._completed)}")
+            self._exposure_open = False
+            self._exposure = set()
+            self._completed = set()
+
     # -- passive-target service thread (the software progress engine) ------
 
     def _serve(self) -> None:
@@ -480,6 +586,20 @@ class Window:
             for waiter, _excl in self._lk_take_grantable():
                 self._comm.send(("ok", None), waiter, self._reply_tag)
             return ("ok", None)
+        if kind == "posted":
+            with self._pscw_cv:
+                self._posted_from.add(msg[1])
+                self._pscw_cv.notify_all()
+            return None  # one-way: start() is the consumer
+        if kind == "complete":
+            with self._pscw_cv:
+                if src not in self._exposure:
+                    return ("err",
+                            f"mpi_tpu: complete() from rank {src} "
+                            f"outside the posted group")
+                self._completed.add(src)
+                self._pscw_cv.notify_all()
+            return ("ok", None)
         if kind == "flush":
             self._lk_check_holder(src, "flush")
             return ("ok", None)
@@ -530,10 +650,14 @@ class Window:
         return out
 
     def _lk_check_holder(self, src: int, what: str) -> None:
-        if self._lk_excl != src and src not in self._lk_shared:
-            raise MpiError(
-                f"mpi_tpu: passive {what} from rank {src} outside a "
-                f"lock epoch (MPI_Win_lock first)")
+        if self._lk_excl == src or src in self._lk_shared:
+            return
+        with self._pscw_cv:
+            if src in self._exposure:  # PSCW access epoch
+                return
+        raise MpiError(
+            f"mpi_tpu: passive {what} from rank {src} outside a "
+            f"lock or PSCW epoch (MPI_Win_lock or post/start first)")
 
     # -- synchronization ---------------------------------------------------
 
@@ -548,6 +672,11 @@ class Window:
                 f"mpi_tpu: Window.fence while holding passive locks on "
                 f"ranks {sorted(self._held)} — unlock first (MPI forbids "
                 f"mixing synchronization modes in one epoch)")
+        if self._access_open or self._exposure_open:
+            raise MpiError(
+                "mpi_tpu: Window.fence inside a PSCW epoch — "
+                "complete()/wait() first (MPI forbids mixing "
+                "synchronization modes in one epoch)")
         n = self._comm.size()
         with self._lock:
             puts, self._puts = self._puts, []
@@ -625,6 +754,14 @@ class Window:
             raise MpiError(
                 f"mpi_tpu: Window.free() while holding passive locks "
                 f"on ranks {sorted(self._held)}")
+        if self._access_open:
+            raise MpiError(
+                f"mpi_tpu: Window.free() inside a PSCW access epoch "
+                f"to ranks {sorted(self._access)} (complete() first)")
+        if self._exposure_open:
+            raise MpiError(
+                "mpi_tpu: Window.free() inside a PSCW exposure epoch "
+                "(wait() first)")
         with self._lock:
             if self._puts or self._gets:
                 raise MpiError(

@@ -1338,3 +1338,28 @@ class TestSmallSurface:
 
         res = run_spmd(main, n=2)
         assert res[0] == [1.0] * 4 and res[1] == [0.0] * 4
+
+    def test_pscw_through_win_wrapper(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            mem = np.zeros(1, np.float64)
+            win = MPI.Win.Create(mem, comm=comm,
+                                 info={"locks": "true"})
+            group = comm.Get_group()
+            if r == 0:
+                win.Post(group)        # everyone will access rank 0
+            win.Start(group.Incl([0]))
+            win.Accumulate(np.float64([r + 1.0]), 0, op=MPI.SUM)
+            win.Complete()
+            if r == 0:
+                win.Wait()
+            comm.Barrier()
+            total = float(mem[0]) if r == 0 else None
+            comm.Barrier()
+            win.Free()
+            MPI.Finalize()
+            return total
+
+        res = run_spmd(main, n=3)
+        assert res[0] == 1.0 + 2.0 + 3.0

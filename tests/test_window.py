@@ -551,3 +551,139 @@ class TestPassiveTarget:
 
 def _bad_op(a, b):
     raise ZeroDivisionError("boom")
+
+
+class TestPscw:
+    """Generalized active target (MPI_Win_post/start/complete/wait):
+    the third RMA synchronization mode, over the same service engine."""
+
+    def test_neighbor_halo_exchange(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            win = mpi_tpu.win_create(w, np.zeros(2, np.float64),
+                                     locks=True)
+            left, right = (r - 1) % n, (r + 1) % n
+            # Each rank ACCESSES its right neighbor, so each rank is
+            # accessed BY its left neighbor: the posted group must be
+            # exactly the origins that will complete (PSCW contract).
+            win.post({left})
+            win.start({right})
+            win.put(np.float64([r + 1.0]), right, 0)     # their slot 0
+            got = float(win.get(right, 1, 1).array[0])   # their slot 1
+            win.complete()
+            win.wait()
+            mine = win.local.copy()
+            w.barrier()
+            win.free()
+            mpi_tpu.finalize()
+            return mine.tolist(), got
+
+        res = spmd(main)
+        for r, (mine, got) in enumerate(res):
+            assert mine[0] == ((r - 1) % N) + 1.0  # left neighbor wrote
+            assert got == 0.0                      # read before any put
+
+    def test_epoch_enforcement(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            win = mpi_tpu.win_create(w, np.zeros(1, np.float32),
+                                     locks=True)
+            outs = []
+            try:
+                win.complete()
+            except api.MpiError as e:
+                outs.append("without an open access" in str(e))
+            try:
+                win.wait()
+            except api.MpiError as e:
+                outs.append("without an open exposure" in str(e))
+            # An op to a target that hasn't posted falls through to
+            # the FENCE queue (no passive epoch) — not an error here.
+            w.barrier()
+            win.free()
+            mpi_tpu.finalize()
+            return outs
+
+        res = spmd(main, n=2)
+        assert all(o == [True, True] for o in res)
+
+    def test_pscw_ticket_pattern(self):
+        """All ranks post to everyone; everyone starts to rank 0 and
+        draws tickets via fetch_and_op — the PSCW twin of the lock
+        counter test."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            win = mpi_tpu.win_create(w, np.zeros(1, np.int64),
+                                     locks=True)
+            if r == 0:          # only rank 0 is accessed
+                win.post(set(range(n)))
+            win.start({0})
+            pre = int(win.fetch_and_op(np.int64(1), 0).array[0])
+            win.complete()
+            if r == 0:
+                win.wait()
+            w.barrier()
+            total = int(win.local[0]) if r == 0 else None
+            tickets = sorted(int(t) for t in w.allgather(pre))
+            w.barrier()
+            win.free()
+            mpi_tpu.finalize()
+            return tickets, total
+
+        res = spmd(main)
+        assert res[0][1] == N
+        for tickets, _ in res:
+            assert tickets == list(range(N))
+
+    def test_empty_group_epochs_are_noops(self):
+        """MPI allows empty post/start groups (the boundary rank of a
+        non-periodic halo pattern): valid no-op epochs."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            win = mpi_tpu.win_create(w, np.zeros(1, np.float32),
+                                     locks=True)
+            win.post(set())
+            win.start(set())
+            win.complete()
+            win.wait()
+            try:
+                win.fence()   # closed epochs: fence is legal again
+                ok = True
+            except api.MpiError:
+                ok = False
+            w.barrier()
+            win.free()
+            mpi_tpu.finalize()
+            return ok
+
+        assert all(spmd(main, n=2))
+
+    def test_fence_inside_pscw_epoch_raises(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            win = mpi_tpu.win_create(w, np.zeros(1, np.float32),
+                                     locks=True)
+            win.post({r})         # self epoch keeps it local
+            win.start({r})
+            try:
+                win.fence()
+                out = "no error"
+            except api.MpiError as e:
+                out = "PSCW epoch" in str(e)
+            win.complete()
+            win.wait()
+            w.barrier()
+            win.free()
+            mpi_tpu.finalize()
+            return out
+
+        assert all(o is True for o in spmd(main, n=2))
