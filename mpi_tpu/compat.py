@@ -1365,6 +1365,36 @@ class File:
     def Write_ordered(self, buf: Any, offset: int = 0) -> int:
         return self._f.write_ordered(np.ascontiguousarray(buf), offset)
 
+    # -- shared file pointer ------------------------------------------------
+    #
+    # One deviation from mpi4py: the shared pointer's counter window
+    # must be created collectively first (Init_shared_pointer — it
+    # runs a per-rank service thread, the same opt-in as Win locks).
+
+    def Init_shared_pointer(self) -> None:
+        """COLLECTIVE: enable the ``*_shared`` family on this file."""
+        self._f.init_shared_pointer()
+
+    def Write_shared(self, buf: Any) -> int:
+        """Atomic append at the shared pointer (MPI_File_write_shared);
+        returns the start offset actually claimed."""
+        return self._f.write_shared(np.ascontiguousarray(buf))
+
+    def Read_shared(self, buf: Any) -> None:
+        out = _writable_buffer(buf, "Read_shared")
+        got = self._f.read_shared(out.size, out.dtype)
+        np.copyto(out, got.reshape(out.shape))
+
+    def Seek_shared(self, offset: int, whence: Optional[int] = None) -> None:
+        if whence not in (None, 0, SEEK_SET):
+            raise api.MpiError(
+                "mpi_tpu.compat: Seek_shared supports whence="
+                "MPI.SEEK_SET only")
+        self._f.seek_shared(int(offset))
+
+    def Get_position_shared(self) -> int:
+        return self._f.get_position_shared()
+
     def Sync(self) -> None:
         self._f.sync()
 
@@ -1435,6 +1465,11 @@ LOCK_EXCLUSIVE = 234
 LOCK_SHARED = 235
 
 KEYVAL_INVALID = -1
+
+# MPI_File seek whence constants (mpi4py's values).
+SEEK_SET = 600
+SEEK_CUR = 602
+SEEK_END = 604
 
 
 def _writable_buffer(buf: Any, what: str) -> np.ndarray:
@@ -2012,6 +2047,9 @@ class _MPI:
     MODE_SEQUENTIAL = MODE_SEQUENTIAL
     LOCK_EXCLUSIVE = LOCK_EXCLUSIVE
     LOCK_SHARED = LOCK_SHARED
+    SEEK_SET = SEEK_SET
+    SEEK_CUR = SEEK_CUR
+    SEEK_END = SEEK_END
     SUM = Op("sum")
     PROD = Op("prod")
     MIN = Op("min")

@@ -96,6 +96,7 @@ class File:
         self._view_block = 1
         self._view_stride = 1
         self._view_index = 0
+        self._sp_win = None  # shared-pointer window (opt-in; see below)
 
     # -- basics -------------------------------------------------------------
 
@@ -147,6 +148,12 @@ class File:
                 pass
         os.close(self._fd)
         self._comm.barrier()
+        if self._sp_win is not None:
+            # After the barrier no rank has a *_shared claim in flight
+            # (an op past one's own close is erroneous per MPI);
+            # Window.free is purely local, so no second barrier.
+            self._sp_win.free()
+            self._sp_win = None
 
     def __enter__(self) -> "File":
         return self
@@ -277,6 +284,102 @@ class File:
                                                   self._view_dtype)
             pos += int(ln)
         return out
+
+    # -- shared file pointer (MPI_File_write_shared family) -----------------
+
+    def init_shared_pointer(self) -> None:
+        """COLLECTIVE: create the shared file pointer — a one-element
+        passive-RMA counter window owned by group rank 0 (the classic
+        MPI-IO shared-pointer realization; fetch_and_op under an
+        exclusive lock IS the atomic pointer claim). Opt-in because the
+        window runs a per-rank service thread; call once after open,
+        on every rank, before any ``*_shared`` op."""
+        self._check_open()
+        if self._sp_win is not None:
+            raise MpiError("mpi_tpu: shared pointer already initialized")
+        from .window import win_create
+
+        size = 1 if self._comm.rank() == 0 else 0
+        self._sp_win = win_create(self._comm, np.zeros(size, np.int64),
+                                  locks=True)
+
+    def _sp(self):
+        win = self._sp_win
+        if win is None:
+            raise MpiError(
+                "mpi_tpu: shared file pointer not initialized — call "
+                "init_shared_pointer() (collective) after open_file")
+        return win
+
+    def _sp_claim(self, nbytes: int) -> int:
+        """Atomically advance the shared pointer by ``nbytes``; returns
+        the claimed start offset."""
+        win = self._sp()
+        win.lock(0, exclusive=True)
+        try:
+            start = int(win.fetch_and_op(np.int64(nbytes), 0).array[0])
+        finally:
+            win.unlock(0)
+        return start
+
+    def get_position_shared(self) -> int:
+        """Current shared-pointer byte offset (MPI_File_get_position_
+        shared): a snapshot — concurrent ``*_shared`` ops move it."""
+        win = self._sp()
+        win.lock(0, exclusive=False)
+        try:
+            return int(win.get(0, 0, 1).array[0])
+        finally:
+            win.unlock(0)
+
+    def seek_shared(self, offset_bytes: int) -> None:
+        """COLLECTIVE: set the shared pointer (MPI_File_seek_shared;
+        every rank passes the same offset)."""
+        win = self._sp()
+        if self._comm.rank() == 0:
+            win.lock(0, exclusive=True)
+            try:
+                win.put(np.int64([int(offset_bytes)]), 0, 0)
+            finally:
+                win.unlock(0)
+        self._comm.barrier()
+
+    def write_shared(self, data: Any) -> int:
+        """Non-collective atomic append at the shared pointer
+        (MPI_File_write_shared): claims ``len(data)`` bytes of the
+        pointer atomically, writes there, returns the start offset.
+        Ordering across ranks is arrival order (MPI leaves it
+        unspecified); each write's span is exclusively its own."""
+        self._check_open(write=True)
+        buf = _as_bytes(data)
+        start = self._sp_claim(len(buf))
+        if buf:
+            self.write_at(start, buf)
+        return start
+
+    def read_shared(self, count: int,
+                    dtype: Any = np.uint8) -> np.ndarray:
+        """Non-collective read at the shared pointer
+        (MPI_File_read_shared): atomically claims up to ``count``
+        elements and reads from the claimed offset. At EOF the claim
+        shrinks to what the file holds (possibly zero) — a SHORT read,
+        as MPI specifies, never a pointer stranded past EOF."""
+        self._check_open()
+        item = np.dtype(dtype).itemsize
+        want = int(count) * item
+        win = self._sp()
+        win.lock(0, exclusive=True)
+        try:
+            cur = int(win.get(0, 0, 1).array[0])
+            avail = max(0, min(want, self.size() - cur))
+            avail -= avail % item  # whole elements only
+            if avail:
+                win.put(np.int64([cur + avail]), 0, 0)
+        finally:
+            win.unlock(0)
+        if not avail:
+            return np.empty(0, dtype)
+        return self.read_at(cur, avail // item, dtype)
 
     # -- ordered write (MPI_File_write_ordered) -----------------------------
 

@@ -1363,3 +1363,25 @@ class TestSmallSurface:
 
         res = run_spmd(main, n=3)
         assert res[0] == 1.0 + 2.0 + 3.0
+
+    def test_file_shared_pointer(self, tmp_path):
+        path = str(tmp_path / "csp.bin")
+
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            f = MPI.File.Open(comm, path,
+                              MPI.MODE_CREATE | MPI.MODE_WRONLY)
+            f.Init_shared_pointer()
+            start = f.Write_shared(np.full(r + 1, r, np.uint8))
+            comm.Barrier()
+            end = f.Get_position_shared()
+            f.Close()
+            MPI.Finalize()
+            return start, end
+
+        res = run_spmd(main, n=3)
+        total = 1 + 2 + 3
+        assert all(end == total for _, end in res)
+        starts = sorted(s for s, _ in res)
+        assert starts[0] == 0 and all(0 <= s < total for s in starts)

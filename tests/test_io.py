@@ -264,3 +264,134 @@ class TestDefaultView:
 
         for got in run_spmd(main, n=2):
             np.testing.assert_array_equal(got, np.arange(16, dtype=np.uint8))
+
+
+class TestSharedPointer:
+    """MPI_File_*_shared over the passive-RMA counter window."""
+
+    def test_write_shared_spans_are_disjoint_and_complete(self, tmp_path):
+        path = str(tmp_path / "shared.bin")
+
+        def main():
+            import mpi_tpu
+            from mpi_tpu.comm import comm_world
+            from mpi_tpu.io import open_file
+
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            f = open_file(w, path, "w")
+            f.init_shared_pointer()
+            # Variable-size appends, several per rank, racing freely.
+            starts = []
+            for k in range(3):
+                payload = bytes([r * 16 + k]) * (r + k + 1)
+                starts.append((f.write_shared(payload), len(payload)))
+            w.barrier()
+            total = f.get_position_shared()
+            f.close()
+            mpi_tpu.finalize()
+            return starts, total
+
+        res = run_spmd(main, n=3)
+        spans = sorted((s, s + ln) for starts, _ in res
+                       for s, ln in starts)
+        total = res[0][1]
+        # Disjoint, gap-free coverage of [0, total).
+        assert spans[0][0] == 0
+        for (a0, a1), (b0, b1) in zip(spans, spans[1:]):
+            assert a1 == b0, spans
+        assert spans[-1][1] == total
+        import os
+        assert os.path.getsize(path) == total
+
+    def test_seek_read_shared_roundtrip(self, tmp_path):
+        path = str(tmp_path / "sharedr.bin")
+
+        def main():
+            import numpy as np
+
+            import mpi_tpu
+            from mpi_tpu.comm import comm_world
+            from mpi_tpu.io import open_file
+
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            f = open_file(w, path, "w")
+            f.init_shared_pointer()
+            if r == 0:
+                f.write_at(0, np.arange(12, dtype=np.uint8))
+            w.barrier()
+            f.seek_shared(0)
+            # Each rank claims 4 bytes; the claimed spans partition
+            # [0, 12) even though claim order is arrival order.
+            got = f.read_shared(4)
+            w.barrier()
+            pos = f.get_position_shared()
+            f.close()
+            mpi_tpu.finalize()
+            return sorted(int(x) for x in got), pos
+
+        res = run_spmd(main, n=3)
+        assert all(pos == 12 for _, pos in res)
+        claimed = sorted(v for got, _ in res for v in got)
+        assert claimed == list(range(12))
+
+    def test_uninitialized_shared_pointer_raises(self, tmp_path):
+        def main():
+            import mpi_tpu
+            from mpi_tpu import api
+            from mpi_tpu.comm import comm_world
+            from mpi_tpu.io import open_file
+
+            mpi_tpu.init()
+            w = comm_world()
+            f = open_file(w, str(tmp_path / "x.bin"), "w")
+            try:
+                f.write_shared(b"abc")
+                out = "no error"
+            except api.MpiError as e:
+                out = "init_shared_pointer" in str(e)
+            f.close()
+            mpi_tpu.finalize()
+            return out
+
+        assert all(run_spmd(main, n=2))
+
+    def test_read_shared_short_at_eof_never_strands_pointer(self, tmp_path):
+        """MPI semantics: a read at EOF shrinks (possibly to zero) and
+        the pointer advances only by what was read — never past EOF."""
+        path = str(tmp_path / "eof.bin")
+
+        def main():
+            import numpy as np
+
+            import mpi_tpu
+            from mpi_tpu.comm import comm_world
+            from mpi_tpu.io import open_file
+
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            f = open_file(w, path, "w")
+            f.init_shared_pointer()
+            if r == 0:
+                f.write_at(0, np.arange(10, dtype=np.uint8))
+            w.barrier()
+            f.seek_shared(0)
+            got = f.read_shared(4)          # claims shrink at EOF
+            w.barrier()
+            pos = f.get_position_shared()
+            extra = f.read_shared(4)        # past EOF: empty, no move
+            w.barrier()
+            pos2 = f.get_position_shared()
+            f.close()
+            mpi_tpu.finalize()
+            return len(got), pos, len(extra), pos2
+
+        res = run_spmd(main, n=3)
+        lens = sorted(n for n, _, _, _ in res)
+        assert sum(lens) == 10 and lens == [2, 4, 4]
+        assert all(p == 10 and e == 0 and p2 == 10
+                   for _, p, e, p2 in res)
