@@ -703,6 +703,30 @@ class Comm:
     def ialltoall(self, sendobj: List[Any]) -> Request:
         return Request(self._c.ialltoall(sendobj))
 
+    # -- error handlers -----------------------------------------------------
+
+    def Set_errhandler(self, errhandler: "Errhandler") -> None:
+        """Route to the native error-handler setting ('return' raises
+        MpiError to the caller — the default here AND what mpi4py code
+        usually sets; 'fatal' aborts the job). Deviation from mpi4py:
+        the handler is PROCESS-global (the native facade has one), not
+        per-communicator — under thread-per-rank drivers every rank
+        shares it, so set it once at startup, not per-rank."""
+        if not isinstance(errhandler, Errhandler):
+            raise api.MpiError(
+                f"mpi_tpu.compat: Set_errhandler expects MPI.ERRORS_"
+                f"RETURN / MPI.ERRORS_ARE_FATAL / a Get_errhandler "
+                f"result, got {errhandler!r}")
+        api.set_errhandler(errhandler._native)
+
+    def Get_errhandler(self) -> "Errhandler":
+        native = api.get_errhandler()
+        if native == "return":
+            return ERRORS_RETURN
+        if native == "fatal":
+            return ERRORS_ARE_FATAL
+        return Errhandler(native)  # user callable: restorable as-is
+
     # -- attribute caching and names ----------------------------------------
 
     # itertools.count.__next__ is atomic in CPython — rank-threads
@@ -1380,10 +1404,21 @@ class File:
         returns the start offset actually claimed."""
         return self._f.write_shared(np.ascontiguousarray(buf))
 
-    def Read_shared(self, buf: Any) -> None:
+    def Read_shared(self, buf: Any) -> int:
+        """Fills ``buf`` from the shared pointer; at EOF the claim
+        shrinks (MPI short-read semantics), only the prefix is
+        written, and the ELEMENT COUNT actually read is returned
+        (mpi4py surfaces it via a Status; here it is the return
+        value)."""
         out = _writable_buffer(buf, "Read_shared")
+        if not out.flags.c_contiguous:
+            raise api.MpiError(
+                "mpi_tpu.compat: Read_shared needs a C-contiguous "
+                "buffer (a strided view's flattening would be a copy "
+                "and the data would vanish)")
         got = self._f.read_shared(out.size, out.dtype)
-        np.copyto(out, got.reshape(out.shape))
+        out.reshape(-1)[:got.size] = got
+        return int(got.size)
 
     def Seek_shared(self, offset: int, whence: Optional[int] = None) -> None:
         if whence not in (None, 0, SEEK_SET):
@@ -1406,6 +1441,54 @@ class File:
 
     def __exit__(self, *exc: Any) -> None:
         self.Close()
+
+
+class Info(dict):
+    """mpi4py ``MPI.Info``: string key/value hints. A dict subclass so
+    every consumer that takes ``info`` (``Win.Create``, ``File.Open``)
+    accepts either spelling; the Create/Set/Get methods are the MPI
+    names."""
+
+    @classmethod
+    def Create(cls) -> "Info":
+        return cls()
+
+    def Set(self, key: str, value: str) -> None:
+        self[str(key)] = str(value)
+
+    def Get(self, key: str) -> Optional[str]:
+        return self.get(str(key))
+
+    def Delete(self, key: str) -> None:
+        self.pop(str(key), None)
+
+    def Get_nkeys(self) -> int:
+        return len(self)
+
+    def Free(self) -> None:
+        self.clear()
+
+    def Dup(self) -> "Info":
+        return Info(self)
+
+
+class Errhandler:
+    """Error-handler handle: wraps the native handler value ('return',
+    'fatal', or a user callable installed through
+    ``mpi_tpu.api.set_errhandler``), so a Get/Set round-trip restores
+    EXACTLY what was installed — including callables."""
+
+    def __init__(self, native: Any):
+        self._native = native
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        if self._native in ("return", "fatal"):
+            return f"MPI.ERRORS_{'RETURN' if self._native == 'return' else 'ARE_FATAL'}"
+        return f"MPI.Errhandler({self._native!r})"
+
+
+ERRORS_RETURN = Errhandler("return")
+ERRORS_ARE_FATAL = Errhandler("fatal")
 
 
 class Op:
@@ -2057,6 +2140,13 @@ class _MPI:
     Status = Status
     Request = Request
     Comm = Comm
+    Info = Info
+    INFO_NULL = None
+    Errhandler = Errhandler
+    ERRORS_RETURN = ERRORS_RETURN
+    ERRORS_ARE_FATAL = ERRORS_ARE_FATAL
+    # mpi4py raises MPI.Exception; here every error IS MpiError.
+    Exception = api.MpiError
     Group = Group
     Cartcomm = Cartcomm
     Distgraphcomm = Distgraphcomm

@@ -1385,3 +1385,63 @@ class TestSmallSurface:
         assert all(end == total for _, end in res)
         starts = sorted(s for s, _ in res)
         assert starts[0] == 0 and all(0 <= s < total for s in starts)
+
+    def test_info_errhandler_exception(self):
+        def main():
+            MPI, comm = _world()
+            info = MPI.Info.Create()
+            info.Set("locks", "true")
+            assert info.Get("locks") == "true"
+            assert info.Get_nkeys() == 1
+            win = MPI.Win.Create(np.zeros(1), comm=comm, info=info)
+            win.Lock(0, MPI.LOCK_SHARED)   # locks enabled via Info
+            win.Unlock(0)
+            comm.Barrier()
+            win.Free()
+            prev = comm.Get_errhandler()
+            comm.Set_errhandler(MPI.ERRORS_RETURN)
+            try:
+                comm.send(object(), dest=99)
+            except MPI.Exception:
+                caught = True
+            comm.Set_errhandler(prev)
+            MPI.Finalize()
+            return caught, info.Dup().Get("locks")
+
+        res = run_spmd(main, n=2)
+        assert all(c and d == "true" for c, d in res)
+
+    def test_read_shared_short_and_callable_errhandler(self, tmp_path):
+        path = str(tmp_path / "cshort.bin")
+
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            f = MPI.File.Open(comm, path,
+                              MPI.MODE_CREATE | MPI.MODE_RDWR)
+            f.Init_shared_pointer()
+            if r == 0:
+                f.Write_at(0, np.arange(5, dtype=np.uint8))
+            comm.Barrier()
+            f.Seek_shared(0)
+            buf = np.zeros(4, np.uint8)
+            got = f.Read_shared(buf)      # short at EOF, no crash
+            comm.Barrier()
+            f.Close()
+            # Callable errhandler round-trips through Get/Set.
+            api.set_errhandler(_cb_errhandler)
+            prev = comm.Get_errhandler()
+            comm.Set_errhandler(MPI.ERRORS_RETURN)
+            comm.Set_errhandler(prev)
+            restored = api.get_errhandler() is _cb_errhandler
+            api.set_errhandler("return")
+            MPI.Finalize()
+            return got, restored
+
+        res = run_spmd(main, n=2)
+        counts = sorted(g for g, _ in res)
+        assert sum(counts) == 5 and all(rst for _, rst in res)
+
+
+def _cb_errhandler(exc):
+    raise exc
