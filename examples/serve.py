@@ -2,13 +2,15 @@
 
 The serving-side twin of ``examples/train.py`` (no reference analogue —
 btracey/mpi has no models): builds the flagship Transformer, then
-generates continuations three ways and cross-checks them:
+generates continuations four ways and cross-checks them:
 
   1. plain greedy KV-cache decode (``models/generate.py``);
   2. the same with weight-only int8 quantized parameters
      (``models/quant.py`` — the HBM-bandwidth lever for decode);
   3. prompt-lookup speculative decoding (``models/speculative.py``) —
-     verified here to match plain greedy exactly.
+     verified here to match plain greedy exactly;
+  4. the state-space LM's recurrent decode (``models/ssm.py``) — no KV
+     cache at all; per-token cost independent of context length.
 
 Run::
 
@@ -47,8 +49,9 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    from mpi_tpu.models import (TransformerConfig, generate, init_params,
-                                quantize_params)
+    from mpi_tpu.models import (SsmConfig, TransformerConfig, generate,
+                                init_params, init_ssm_params,
+                                quantize_params, ssm_decode)
     from mpi_tpu.models.speculative import generate_lookahead
 
     cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
@@ -95,7 +98,22 @@ def main() -> int:
                                    ngram=args.ngram)))
     exact = bool(jnp.array_equal(spec, ref))
     print(f"speculative == greedy: {exact}")
-    return 0 if (exact and int8_valid) else 1
+
+    # 4. the state-space LM: recurrent decode with NO KV cache — the
+    # per-token cost is context-length independent (the structural
+    # contrast with everything above).
+    scfg = SsmConfig(vocab=cfg.vocab, d_model=cfg.d_model, n_layers=2,
+                     d_state=32, d_ff=cfg.d_ff)
+    sparams = init_ssm_params(scfg, jax.random.PRNGKey(1))
+    ssm_out = timed("ssm decode (no KV cache)",
+                    lambda: ssm_decode(scfg, sparams, prompt,
+                                       args.tokens))
+    s_np = np.asarray(ssm_out[:, prompt.shape[1]:])
+    ssm_valid = bool((s_np >= 0).all() and (s_np < scfg.vocab).all()
+                     and ssm_out.shape ==
+                     (args.batch, args.prompt_len + args.tokens))
+    print(f"ssm output valid: {ssm_valid}")
+    return 0 if (exact and int8_valid and ssm_valid) else 1
 
 
 if __name__ == "__main__":
