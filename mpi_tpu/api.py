@@ -480,23 +480,43 @@ def exchange(impl: Interface, data: Any, dest: int, source: int, tag: int,
     return result[0]
 
 
+def _claim_probed(recv: Callable[[int, int], Any],
+                  cancel: Optional[Callable[[int, int], bool]],
+                  src: int, tag: int) -> Tuple[bool, Any]:
+    """ONE bounded claim attempt on a just-probed ``(src, tag)`` — the
+    subtle heart of every probe-then-claim loop (receive_any, mprobe,
+    improbe), defined once. A probe hit is only a HINT: a sibling may
+    consume the message between probe and claim, so the claim is a
+    short bounded receive; if nothing lands, the parked receive is
+    cancelled (the driver's generation-tagged cancel — the machinery
+    ``exchange`` uses). Returns ``(True, payload)`` on a successful
+    claim, ``(False, None)`` when a sibling holds the pair (TagError)
+    or consumed the message (cancelled); re-raises the receive's own
+    errors."""
+    req = Request(lambda: recv(src, tag))
+    try:
+        return True, req.wait(timeout=0.05)
+    except TagError:
+        return False, None  # a sibling holds this {src, tag} right now
+    except MpiError:
+        if req.test():
+            raise  # the operation's own error — surface it
+        # Bounded wait expired: probably consumed by someone else.
+        # Cancel our parked receive; if cancellation lost the race (a
+        # sender engaged after all), the receive is completing — take it.
+        if cancel is not None and cancel(src, tag):
+            return False, None
+        return True, req.wait(None)
+
+
 def _receive_any_loop(probe: Callable[[int, int], bool],
                       recv: Callable[[int, int], Any],
                       cancel: Optional[Callable[[int, int], bool]],
                       me: int, n: int, tag: int,
                       timeout: Optional[float],
                       what: str) -> Tuple[int, Any]:
-    """Shared ANY_SOURCE engine for the facade and :class:`Comm`.
-
-    Probe-then-claim: a probe hit is only a HINT — a sibling
-    ``receive_any`` (or a plain matched receive in another thread) may
-    consume the message between our probe and claim. Blocking
-    unboundedly on the claim would then hang past any timeout, so the
-    claim runs as a short bounded receive: if nothing lands, the
-    registered receive is cancelled (the driver's generation-tagged
-    cancel — the same machinery ``exchange`` uses) and polling resumes.
-    A sibling HOLDING the slot surfaces as :class:`TagError` and is
-    likewise re-polled past."""
+    """Shared ANY_SOURCE engine for the facade and :class:`Comm`:
+    poll every source's probe, :func:`_claim_probed` on a hit."""
     deadline = None if timeout is None else time.monotonic() + timeout
     # Rotate the probe order by own rank so N concurrent wildcard
     # receivers don't all stampede the same source first (starting at
@@ -506,21 +526,9 @@ def _receive_any_loop(probe: Callable[[int, int], bool],
         for src in order:
             if not probe(src, tag):
                 continue
-            req = Request(lambda s=src: recv(s, tag))
-            try:
-                return src, req.wait(timeout=0.05)
-            except TagError:
-                continue  # a sibling holds this {src, tag} right now
-            except MpiError:
-                if req.test():
-                    raise  # the operation's own error — surface it
-                # Bounded wait expired: the probed message was consumed
-                # by someone else. Cancel our parked receive and move
-                # on; if cancellation lost the race (a sender engaged
-                # after all), the receive is completing — take it.
-                if cancel is not None and cancel(src, tag):
-                    continue
-                return src, req.wait(None)
+            won, payload = _claim_probed(recv, cancel, src, tag)
+            if won:
+                return src, payload
         if deadline is not None and time.monotonic() >= deadline:
             raise MpiError(
                 f"mpi_tpu: {what}(tag={tag}) timed out after "

@@ -58,8 +58,8 @@ if TYPE_CHECKING:
 
 from .api import Interface, MpiError, Request, exchange as _exchange
 
-__all__ = ["Comm", "CartComm", "cart_create", "comm_world", "comm_self",
-           "SELF_CTX", "CTX_SPAN",
+__all__ = ["Comm", "CartComm", "Message", "cart_create", "comm_world",
+           "comm_self", "SELF_CTX", "CTX_SPAN",
            "USER_TAG_SPAN"]
 
 CTX_SPAN = 1 << 44        # tag-space region per context
@@ -334,6 +334,60 @@ class Comm:
         return _receive_any_loop(
             self.iprobe, self.receive, self.cancel_receive,
             self.rank(), self.size(), tag, timeout, "Comm.receive_any")
+
+    # -- matched probe (MPI_Mprobe / MPI_Improbe) --------------------------
+
+    def mprobe(self, source: Optional[int], tag: int,
+               timeout: Optional[float] = None) -> "Message":
+        """Matched probe: block until a message with ``tag`` from
+        ``source`` is matched AND claimed by this caller — after
+        return, no sibling receive can steal it (the thread-safety
+        hole MPI_Mprobe exists to close). ``source=None`` follows this
+        class's PROC_NULL convention (probe/sendrecv do the same):
+        the result is the no-proc message, whose ``recv()`` returns
+        ``None`` immediately (MPI_MESSAGE_NO_PROC). For ANY_SOURCE
+        use :meth:`mprobe_any`. Claiming completes the transfer here,
+        so the sender's rendezvous ack fires at mprobe, not at
+        :meth:`Message.recv` — a documented deviation (MPI permits
+        buffering at match time)."""
+        import time as _time
+
+        from .api import _claim_probed
+
+        if source is None:  # PROC_NULL: immediate no-proc message
+            return Message(None, tag, None)
+        deadline = (None if timeout is None
+                    else _time.monotonic() + timeout)
+        while True:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - _time.monotonic()))
+            self.probe(source, tag, remaining)
+            won, payload = _claim_probed(self.receive,
+                                         self.cancel_receive,
+                                         source, tag)
+            if won:
+                return Message(source, tag, payload)
+            _time.sleep(0.0005)  # sibling took it; re-probe
+
+    def mprobe_any(self, tag: int,
+                   timeout: Optional[float] = None) -> "Message":
+        """Matched probe with MPI_ANY_SOURCE: first matching message
+        from any group member, claimed (same engine as
+        :meth:`receive_any`)."""
+        src, payload = self.receive_any(tag, timeout)
+        return Message(src, tag, payload)
+
+    def improbe(self, source: int, tag: int) -> Optional["Message"]:
+        """Nonblocking matched probe (MPI_Improbe): a claimed
+        :class:`Message`, or ``None`` when nothing is matchable now
+        (including losing the claim race to a sibling)."""
+        from .api import _claim_probed
+
+        if not self.iprobe(source, tag):
+            return None
+        won, payload = _claim_probed(self.receive, self.cancel_receive,
+                                     source, tag)
+        return Message(source, tag, payload) if won else None
 
     # -- tag mapping -------------------------------------------------------
 
@@ -697,6 +751,34 @@ def comm_self(impl: Optional[Interface] = None) -> Comm:
     if impl is None:
         impl = api._require_init()
     return Comm(impl, (impl.rank(),), SELF_CTX)
+
+
+class Message:
+    """A matched-and-claimed message (MPI_Message, from
+    :meth:`Comm.mprobe`/:meth:`Comm.improbe`): the payload is already
+    transferred, so :meth:`recv` hands it over race-free. Single-use."""
+
+    __slots__ = ("source", "tag", "_payload", "_taken")
+
+    def __init__(self, source: int, tag: int, payload: Any):
+        self.source = source
+        self.tag = tag
+        self._payload = payload
+        self._taken = False
+
+    def recv(self) -> Any:
+        """The matched payload (MPI_Mrecv). Raises on second use
+        (the handle is consumed, like MPI_MESSAGE_NULL)."""
+        if self._taken:
+            raise MpiError(
+                "mpi_tpu: Message.recv on an already-received message")
+        self._taken = True
+        payload, self._payload = self._payload, None
+        return payload
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        state = "consumed" if self._taken else "pending"
+        return f"Message(source={self.source}, tag={self.tag}, {state})"
 
 
 class CartComm(Comm):

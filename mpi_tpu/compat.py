@@ -161,6 +161,34 @@ class Request:
     waitany = Waitany
 
 
+class Message:
+    """mpi4py ``MPI.Message`` over :class:`mpi_tpu.comm.Message`: a
+    matched-and-claimed message handle from ``mprobe``/``improbe``."""
+
+    def __init__(self, native):
+        self._m = native
+
+    @property
+    def source(self) -> int:
+        return self._m.source
+
+    def recv(self, status: Optional[Status] = None) -> Any:
+        obj = self._m.recv()
+        if status is not None:
+            status.source, status.tag = self._m.source, self._m.tag
+            status.count = _payload_count(obj)
+        return obj
+
+    def Recv(self, buf: Any, status: Optional[Status] = None) -> None:
+        """Buffer form (MPI_Mrecv): the payload lands in ``buf``."""
+        target = _RecvTarget(buf, "Message.Recv")
+        obj = self._m.recv()
+        target.fill(obj)
+        if status is not None:
+            status.source, status.tag = self._m.source, self._m.tag
+            status.count = _payload_count(np.asarray(obj))
+
+
 class _AnySourceRequest(Request):
     """irecv(ANY_SOURCE): the native op yields (source, payload);
     ``wait(status)`` fills the status with the real sender — the
@@ -355,6 +383,44 @@ class Comm:
     # mpi4py exposes both spellings (probe == Probe etc.).
     Probe = probe
     Iprobe = iprobe
+
+    # -- matched probe (MPI_Mprobe family) ----------------------------------
+
+    def mprobe(self, source: int = -1, tag: int = 0,
+               status: Optional[Status] = None) -> "Message":
+        """Matched probe: the returned :class:`Message` is claimed —
+        no sibling receive can steal it (the thread-safe wildcard
+        pattern MPI_Mprobe exists for)."""
+        _check_tag_not_wild(tag, "mprobe")
+        if source == ANY_SOURCE:
+            native = self._c.mprobe_any(tag)
+        elif source == PROC_NULL:
+            native = self._c.mprobe(None, tag)  # no-proc message
+        else:
+            native = self._c.mprobe(source, tag)
+        if status is not None:
+            status.source, status.tag = native.source, tag
+            status.count = _payload_count(native._payload)
+        return Message(native)
+
+    def improbe(self, source: int = -1, tag: int = 0,
+                status: Optional[Status] = None) -> Optional["Message"]:
+        _check_tag_not_wild(tag, "improbe")
+        if source == ANY_SOURCE:
+            src = self._iprobe_any(tag)
+            if src is None:
+                return None
+            source = src
+        native = self._c.improbe(source, tag)
+        if native is None:
+            return None
+        if status is not None:
+            status.source, status.tag = native.source, tag
+            status.count = _payload_count(native._payload)
+        return Message(native)
+
+    Mprobe = mprobe
+    Improbe = improbe
 
     # -- buffer-based p2p (uppercase: numpy arrays, no repickling) ----------
     #
@@ -2140,6 +2206,7 @@ class _MPI:
     Status = Status
     Request = Request
     Comm = Comm
+    Message = Message
     Info = Info
     INFO_NULL = None
     Errhandler = Errhandler

@@ -852,3 +852,81 @@ class TestTcpDriver:
         c._ctx += 1
         with pytest.raises(mpi_tpu.MpiError, match="context space"):
             c._map_tag(0)
+
+
+class TestMatchedProbe:
+    """MPI_Mprobe/Improbe: matched messages are claimed atomically."""
+
+    def test_mprobe_claims_out_of_order(self):
+        """Sender ships A then B on one tag; receiver mprobes (claims
+        A), plain-receives B, then reads A from the handle — claimed
+        messages are immune to later receives."""
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            if r == 0:
+                w.send({"msg": "A"}, 1, 5)   # rendezvous: accepted at mprobe
+                w.send({"msg": "B"}, 1, 5)
+                out = None
+            else:
+                m = w.mprobe(0, 5)
+                assert m.source == 0 and m.tag == 5
+                b = w.receive(0, 5)
+                a = m.recv()
+                out = (a["msg"], b["msg"])
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[1] == ("A", "B")
+
+    def test_improbe_miss_and_hit(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            if r == 0:
+                assert w.improbe(1, 9) is None       # nothing yet
+                w.barrier()
+                w.probe(1, 9, timeout=30)
+                m = w.improbe(1, 9)
+                assert m is not None
+                out = m.recv()
+                # single-use handle
+                try:
+                    m.recv()
+                    out2 = "no error"
+                except mpi_tpu.MpiError as e:
+                    out2 = "already-received" in str(e)
+                w.barrier()
+            else:
+                w.barrier()
+                w.send(42, 0, 9)
+                w.barrier()
+                out, out2 = None, None
+            mpi_tpu.finalize()
+            return out, out2
+
+        res = run_spmd(main, n=2)
+        assert res[0] == (42, True)
+
+    def test_mprobe_any_source(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r, n = w.rank(), w.size()
+            if r == 0:
+                got = sorted(w.mprobe_any(7).recv()
+                             for _ in range(n - 1))
+                # PROC_NULL convention: the no-proc message, instantly.
+                assert w.mprobe(None, 7).recv() is None
+                out = got
+            else:
+                w.send(r * 10, 0, 7)
+                out = None
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=3)
+        assert res[0] == [10, 20]

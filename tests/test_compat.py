@@ -1443,5 +1443,45 @@ class TestSmallSurface:
         assert sum(counts) == 5 and all(rst for _, rst in res)
 
 
+class TestMatchedProbeCompat:
+    def test_mprobe_message_through_compat(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            if r == 0:
+                comm.Send(np.arange(4, dtype=np.float64), 1, tag=11)
+                out = None
+            else:
+                st = MPI.Status()
+                m = comm.Mprobe(source=0, tag=11, status=st)
+                buf = np.zeros(4)
+                m.Recv(buf)
+                out = (buf.tolist(), st.Get_source(), st.Get_count())
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        vals, src, cnt = res[1]
+        assert vals == [0.0, 1.0, 2.0, 3.0] and src == 0 and cnt == 4
+
+    def test_mprobe_any_source_compat(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            if r == 0:
+                got = sorted(comm.mprobe(source=MPI.ANY_SOURCE,
+                                         tag=13).recv()
+                             for _ in range(n - 1))
+                out = got
+            else:
+                comm.send(r, dest=0, tag=13)
+                out = None
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=3)
+        assert res[0] == [1, 2]
+
+
 def _cb_errhandler(exc):
     raise exc
