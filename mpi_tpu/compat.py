@@ -22,17 +22,24 @@ xla, hybrid), so "mpi4py code" transparently runs its collectives as
 compiled XLA programs on TPU.
 
 One-sided RMA (``MPI.Win.Create`` + ``Put``/``Get``/``Accumulate``/
-``Get_accumulate``/``Fetch_and_op``/``Fence``), parallel IO
+``Get_accumulate``/``Fetch_and_op`` under all THREE sync modes:
+``Fence``, passive ``Lock``/``Unlock``/``Flush``, and PSCW
+``Post``/``Start``/``Complete``/``Wait``), parallel IO
 (``MPI.File.Open`` + ``Read_at``/``Write_at``/collective ``_all``
-variants/``Set_view``), Cartesian topologies (``comm.Create_cart`` +
+variants/``Set_view``/the ``*_shared`` shared-pointer family),
+Cartesian topologies (``comm.Create_cart`` +
 ``Get_coords``/``Shift``/``Sub``), distributed graphs
 (``Create_dist_graph_adjacent`` + neighbor collectives),
 intercommunicators (``Create_intercomm``/``Merge`` + the
-``MPI.ROOT``/``MPI.PROC_NULL`` rooted-op protocol), and groups
+``MPI.ROOT``/``MPI.PROC_NULL`` rooted-op protocol), groups
 (``Get_group``/``Incl``/``Excl``/``Translate_ranks``/
-``Create_group``) are wrapped over the native :mod:`mpi_tpu.window`,
-:mod:`mpi_tpu.io`, :class:`mpi_tpu.comm.CartComm`,
-:mod:`mpi_tpu.distgraph`, and :mod:`mpi_tpu.intercomm` subsystems.
+``Create_group``), matched probes (``mprobe``/``improbe`` →
+``MPI.Message`` with race-free ``recv``/``Recv``), ``MPI.Info``
+hints, error handlers (``ERRORS_RETURN``/``ERRORS_ARE_FATAL``), comm
+attributes/names, and ``COMM_SELF`` are wrapped over the native
+:mod:`mpi_tpu.window`, :mod:`mpi_tpu.io`,
+:class:`mpi_tpu.comm.CartComm`, :mod:`mpi_tpu.distgraph`, and
+:mod:`mpi_tpu.intercomm` subsystems.
 
 Datatypes: the named basics (``MPI.DOUBLE``/``MPI.INT``/...) map onto
 numpy dtypes; buffer specs ``[buf, count, datatype]`` work on the
