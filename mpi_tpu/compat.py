@@ -126,7 +126,8 @@ def _payload_count(obj: Any) -> int:
 
 class Request:
     """Wraps the native request; mpi4py method names (including the
-    classmethod set operations ``Waitall``/``Waitany``)."""
+    classmethod set operations ``Waitall``/``Waitany``/``Waitsome``/
+    ``Testall``/``Testany``)."""
 
     def __init__(self, inner: "api.Request"):
         self._inner = inner
@@ -166,6 +167,57 @@ class Request:
         return idx, result
 
     waitany = Waitany
+
+    @classmethod
+    def Testall(cls, requests: List["Request"]) -> bool:
+        """True iff every (non-null) request has completed — without
+        blocking and WITHOUT consuming results (call Waitall to
+        collect them, as in mpi4py's uppercase form)."""
+        return all(r is None or r.test() for r in requests)
+
+    @classmethod
+    def testall(cls, requests: List["Request"]):
+        """mpi4py's lowercase contract: ``(flag, msgs)`` — when every
+        request has completed, the payloads come along (consumed, as
+        ``waitall`` would); otherwise ``(False, None)``."""
+        if not cls.Testall(requests):
+            return False, None
+        return True, cls.Waitall(requests)
+
+    @classmethod
+    def Testany(cls, requests: List["Request"]):
+        """(index, flag, result): the first already-completed request
+        (consumed: its slot becomes None), or
+        ``(MPI.UNDEFINED, False, None)`` when none is ready. mpi4py
+        returns (index, flag); the payload rides along here like the
+        other set operations."""
+        for i, r in enumerate(requests):
+            if r is not None and r.test():
+                result = r.wait()
+                requests[i] = None
+                return i, True, result
+        return UNDEFINED, False, None
+
+    testany = Testany
+
+    @classmethod
+    def Waitsome(cls, requests: List["Request"]):
+        """Block until at least one request completes; returns
+        (indices, results) for EVERY request complete at that moment
+        (all consumed: their slots become None), or ``(None, None)``
+        when every slot is already null (MPI_UNDEFINED case)."""
+        if all(r is None for r in requests):
+            return None, None
+        first, first_result = cls.Waitany(requests)
+        indices, results = [first], [first_result]
+        for i, r in enumerate(requests):
+            if r is not None and r.test():
+                results.append(r.wait())
+                indices.append(i)
+                requests[i] = None
+        return indices, results
+
+    waitsome = Waitsome
 
 
 class Message:

@@ -1485,3 +1485,60 @@ class TestMatchedProbeCompat:
 
 def _cb_errhandler(exc):
     raise exc
+
+
+class TestRequestSetOps:
+    def test_testall_testany_waitsome(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            # Nothing posted yet: Testany says so without blocking.
+            idx, flag, _ = MPI.Request.Testany([])
+            assert (idx, flag) == (MPI.UNDEFINED, False)
+            sends = [comm.isend(r * 100 + j, dest=j, tag=500 + r)
+                     for j in range(n)]
+            recvs = [comm.irecv(source=j, tag=500 + j)
+                     for j in range(n)]
+            # Drain with Waitsome until every slot is null.
+            got = {}
+            while True:
+                out = MPI.Request.Waitsome(recvs)
+                if out == (None, None):
+                    break
+                for i, v in zip(*out):
+                    got[i] = v
+            assert MPI.Request.Testall(recvs)   # all null -> True
+            MPI.Request.Waitall(sends)
+            assert MPI.Request.Testall(sends)
+            MPI.Finalize()
+            return got
+
+        res = run_spmd(main, n=3)
+        for r, got in enumerate(res):
+            assert got == {j: j * 100 + r for j in range(3)}
+
+
+class TestLowercaseTestall:
+    def test_testall_tuple_contract(self):
+        def main():
+            MPI, comm = _world()
+            r, n = comm.Get_rank(), comm.Get_size()
+            sends = [comm.isend(j, dest=j, tag=800 + r)
+                     for j in range(n)]
+            recvs = [comm.irecv(source=j, tag=800 + j)
+                     for j in range(n)]
+            flag, msgs = True, None
+            # Poll the lowercase form until complete.
+            import time
+            while True:
+                flag, msgs = MPI.Request.testall(recvs)
+                if flag:
+                    break
+                time.sleep(0.001)
+            MPI.Request.Waitall(sends)
+            MPI.Finalize()
+            return msgs
+
+        res = run_spmd(main, n=2)
+        for r, msgs in enumerate(res):
+            assert msgs == [r, r]
