@@ -2,9 +2,10 @@
 
 The drop-in story, end to end: everything below is written exactly as
 an mpi4py tutorial would write it — pickle p2p, buffer collectives,
-one-sided RMA through ``MPI.Win``, parallel IO through ``MPI.File``, a
-Cartesian grid — and the ONLY difference from running it under mpi4py
-is the import line. A user of the reference (or of any MPI binding)
+one-sided RMA through ``MPI.Win`` (fence AND passive-target lock
+epochs), derived datatypes with ``IN_PLACE`` and ``Gatherv``, matched
+probes, parallel IO through ``MPI.File``, a Cartesian grid — and the
+ONLY difference from running it under mpi4py is the import line. A user of the reference (or of any MPI binding)
 ports their script by changing that one line; the collectives then run
 on whichever driver is active (compiled XLA on TPU).
 
@@ -87,6 +88,58 @@ src, dst = cart.Shift(1, 1)
 got = cart.sendrecv(rank, dest=dst, source=src, sendtag=11)
 assert got == cart.Get_cart_rank(
     [cart.coords[0], (cart.coords[1] - 1) % dims[1]])
+
+# --------------------------- 6. derived datatypes, IN_PLACE, Gatherv
+
+grid = np.arange(16, dtype=np.float64).reshape(4, 4) + 100 * rank
+col = MPI.DOUBLE.Create_vector(4, 1, 4).Commit()   # one column
+if rank == 0:
+    comm.Send([grid, 1, col], dest=1, tag=21)      # strided, no copy
+elif rank == 1:
+    landing = np.zeros((4, 4))
+    comm.Recv([landing, 1, col], source=0, tag=21)
+    assert (landing[:, 0] == grid[:, 0] - 100).all()
+
+acc = np.full(2, float(rank + 1))
+comm.Allreduce(MPI.IN_PLACE, acc, op=MPI.SUM)
+assert acc[0] == sum(range(1, size + 1))
+
+counts = [i + 1 for i in range(size)]
+mine = np.full(counts[rank], float(rank))
+table = np.zeros(sum(counts)) if rank == 0 else None
+comm.Gatherv(mine, [table, counts, None, MPI.DOUBLE] if rank == 0
+             else None, root=0)
+if rank == 0:
+    assert table[-1] == float(size - 1)
+
+# ------------------------ 7. passive-target lock (no fence anywhere)
+
+bank = np.zeros(1, np.int64)
+info = MPI.Info.Create()            # a dict would break real mpi4py
+info.Set("locks", "true")
+pwin = MPI.Win.Create(bank, comm=comm, info=info)
+pwin.Lock(0, MPI.LOCK_EXCLUSIVE)
+cur = np.zeros(1, np.int64)
+pwin.Get(cur, 0)
+pwin.Flush(0)      # Get must complete before its value is used (MPI)
+pwin.Put(cur + rank + 1, 0)
+pwin.Unlock(0)
+comm.Barrier()
+if rank == 0:
+    assert int(bank[0]) == sum(range(1, size + 1))
+comm.Barrier()
+pwin.Free()
+
+# ----------------------------------- 8. matched probe (thread-safe)
+
+if rank == 0:
+    msg = comm.mprobe(source=MPI.ANY_SOURCE, tag=31)
+    first = msg.recv()
+    rest = sorted(comm.mprobe(source=MPI.ANY_SOURCE, tag=31).recv()
+                  for _ in range(size - 2))
+    assert sorted([first] + rest) == list(range(1, size))
+else:
+    comm.send(rank, dest=0, tag=31)
 
 print(f"rank {rank}/{size}: pi={pi:.6f} ticket={int(ticket[0])} "
       f"coords={cart.coords} — mpi4py surface OK")

@@ -522,17 +522,45 @@ def _receive_any_loop(probe: Callable[[int, int], bool],
     # receivers don't all stampede the same source first (starting at
     # self is arbitrary).
     order = [(me + i) % n for i in range(n)]
+    # A peer that already finalized (its connections closed) makes its
+    # probe RAISE — but a wildcard receive awaiting a LIVE sender must
+    # not die because an unrelated peer exited first (a legal MPI
+    # program: finalize when none of YOUR communication is pending).
+    # Transport-death probe errors count as nothing-to-probe; the
+    # blacklist clears periodically so a TRANSIENT error cannot turn
+    # into permanent deafness. When every remote peer is dead the
+    # death is surfaced (self never raises, and a self-only wildcard
+    # wait after every peer died is not a supported pattern — use the
+    # matched receive(me, tag) for that).
+    dead: dict = {}
+    sweeps = 0
     while True:
         for src in order:
-            if not probe(src, tag):
+            if src in dead:
+                continue
+            try:
+                hit = probe(src, tag)
+            except (ConnectionError, OSError, MpiError) as exc:
+                dead[src] = exc
+                continue
+            if not hit:
                 continue
             won, payload = _claim_probed(recv, cancel, src, tag)
             if won:
                 return src, payload
+        if n > 1 and len(dead) >= n - 1:
+            err = next(iter(dead.values()))
+            raise MpiError(
+                f"mpi_tpu: {what}(tag={tag}): every remote source is "
+                f"unreachable (peers closed); first error: "
+                f"{err}") from err
         if deadline is not None and time.monotonic() >= deadline:
             raise MpiError(
                 f"mpi_tpu: {what}(tag={tag}) timed out after "
                 f"{timeout}s with no matching message")
+        sweeps += 1
+        if sweeps % 512 == 0:
+            dead.clear()  # re-probe: transient errors must recover
         time.sleep(0.0005)
 
 

@@ -187,10 +187,14 @@ class Request:
     @classmethod
     def Testany(cls, requests: List["Request"]):
         """(index, flag, result): the first already-completed request
-        (consumed: its slot becomes None), or
-        ``(MPI.UNDEFINED, False, None)`` when none is ready. mpi4py
-        returns (index, flag); the payload rides along here like the
-        other set operations."""
+        (consumed: its slot becomes None); ``(MPI.UNDEFINED, True,
+        None)`` when there are no active requests at all (MPI's
+        no-active-handles case — flag TRUE, so drain loops terminate);
+        ``(MPI.UNDEFINED, False, None)`` when active requests exist
+        but none is ready. mpi4py returns (index, flag); the payload
+        rides along here like the other set operations."""
+        if all(r is None for r in requests):
+            return UNDEFINED, True, None
         for i, r in enumerate(requests):
             if r is not None and r.test():
                 result = r.wait()

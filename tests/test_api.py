@@ -444,3 +444,33 @@ class TestNonblocking:
         with pytest.raises(ValueError, match="boom1"):
             api.waitall(reqs, timeout=5)
         assert all(r.test() for r in reqs)
+
+
+class TestReceiveAnyPeerExit:
+    def test_wildcard_survives_unrelated_peer_finalize(self):
+        """A legal MPI program: rank 2 finalizes early (none of ITS
+        communication pending) while rank 0 still wildcard-receives
+        from rank 1 — the dead peer's closed sockets must read as
+        nothing-to-probe, not kill the receive."""
+        import time
+
+        from conftest import run_on_ranks, tcp_cluster
+
+        with tcp_cluster(3) as nets:
+            def body(net, r):
+                from mpi_tpu.comm import comm_world
+
+                w = comm_world(net)
+                if r == 2:
+                    net.finalize()      # close MY sockets early
+                    return "gone"
+                if r == 1:
+                    time.sleep(0.5)     # let rank 2's exit land first
+                    w.send(41, 0, 15)
+                    return "sent"
+                src, val = w.receive_any(15, timeout=30)
+                return (src, val)
+
+            out = run_on_ranks(nets, body, timeout=60.0)
+        assert out[2] == "gone" and out[1] == "sent"
+        assert out[0] == (1, 41)

@@ -1492,9 +1492,12 @@ class TestRequestSetOps:
         def main():
             MPI, comm = _world()
             r, n = comm.Get_rank(), comm.Get_size()
-            # Nothing posted yet: Testany says so without blocking.
+            # No active handles: MPI defines flag=True with
+            # index=UNDEFINED (drain loops terminate on this).
             idx, flag, _ = MPI.Request.Testany([])
-            assert (idx, flag) == (MPI.UNDEFINED, False)
+            assert (idx, flag) == (MPI.UNDEFINED, True)
+            idx, flag, _ = MPI.Request.Testany([None, None])
+            assert (idx, flag) == (MPI.UNDEFINED, True)
             sends = [comm.isend(r * 100 + j, dest=j, tag=500 + r)
                      for j in range(n)]
             recvs = [comm.irecv(source=j, tag=500 + j)
