@@ -127,9 +127,9 @@ def _lru_scan(blk, u: jax.Array, scan_fn=None, with_state=False):
 
     lam, gam = _lam_gam(blk)
     # Drive term in complex64: (b, s, h)
-    drive = jnp.einsum("bsd,dh->bsh", u.astype(jnp.float32),
-                       blk["b_re"]) + 1j * jnp.einsum(
-        "bsd,dh->bsh", u.astype(jnp.float32), blk["b_im"])
+    u32 = u.astype(jnp.float32)
+    drive = (jnp.einsum("bsd,dh->bsh", u32, blk["b_re"])
+             + 1j * jnp.einsum("bsd,dh->bsh", u32, blk["b_im"]))
     drive = gam[None, None] * drive.astype(jnp.complex64)
     a = jnp.broadcast_to(lam[None, None], drive.shape)
     if scan_fn is None:
@@ -213,10 +213,9 @@ def ssm_step(cfg: SsmConfig, params: Dict[str, Any], state: list,
         h = _layernorm(x, blk["ln1"]["scale"].astype(x.dtype),
                        blk["ln1"]["bias"].astype(x.dtype))
         lam, gam = _lam_gam(blk)
-        drive = (jnp.einsum("bd,dh->bh", h.astype(jnp.float32),
-                            blk["b_re"])
-                 + 1j * jnp.einsum("bd,dh->bh",
-                                   h.astype(jnp.float32), blk["b_im"]))
+        h32 = h.astype(jnp.float32)
+        drive = (jnp.einsum("bd,dh->bh", h32, blk["b_re"])
+                 + 1j * jnp.einsum("bd,dh->bh", h32, blk["b_im"]))
         s = lam[None] * s + gam[None] * drive.astype(jnp.complex64)
         new_state.append(s)
         y = (jnp.einsum("bh,hd->bd", s.real, blk["c_re"])
@@ -321,9 +320,7 @@ def make_ssm_train_step(cfg: SsmConfig, learning_rate: float = 1e-3,
     tok_sharding = NamedSharding(mesh, P("dp", None))
     repl = NamedSharding(mesh, P())
 
-    def init_sharded(key):
-        st = jax.jit(init_state, out_shardings=repl)(key)
-        return st
+    init_sharded = jax.jit(init_state, out_shardings=repl)
 
     step = jax.jit(step_body,
                    in_shardings=(repl, tok_sharding),
