@@ -27,11 +27,11 @@ slow tier therefore carries one buffer per host, not one per rank.
 Cross-host point-to-point composes ``(src, dst, user_tag)`` into a single
 host-level wire tag (bit 62 set — disjoint from user tags, which live
 below 2^48, and from the collective tag space at 2^48..2^62). Cross-host
-sends therefore require ``0 <= tag < 2**32 - 2**20`` (the top 2**20 of
-the field is the RMA window-service band: window.py's reserved i64
-service tags remap into it so passive-target lock/unlock works across
-hosts) and at most 2**15 global ranks; intra-host tags are
-unrestricted.
+sends therefore require ``0 <= tag < 2**32 - 2**21`` (the top 2**21 of
+the field is the partitioned-p2p + RMA window-service band: those
+reserved i64 tag slices remap into it so passive-target lock/unlock
+and MPI-4 partitioned sends work across hosts) and at most 2**15
+global ranks; intra-host tags are unrestricted.
 """
 
 from __future__ import annotations
@@ -60,19 +60,20 @@ _WIN_BAND_CACHE: Optional[Tuple[int, int]] = None
 
 
 def _win_tag_band() -> Tuple[int, int]:
-    """The RMA window-service tag slice (window.py's _svc_tags carve it
-    out of the world collective space, from comm._win_tag_base — the
-    shared definition) as (lo, hi) — these i64 tags must cross hosts
-    for passive-target RMA to work over the hybrid driver, so
-    _compose_tag remaps them reversibly into the TOP _WIN_SLICE of the
-    32-bit composed-tag field. Cached: this sits on the per-operation
-    wire path and the service thread's poll loop."""
+    """The reserved service tag band — the PARTITIONED-p2p slice plus
+    the RMA window-service slice, contiguous by construction in
+    comm.py's layout — as (lo, hi). These i64 tags must cross hosts
+    for passive-target RMA and partitioned sends to work over the
+    hybrid driver, so _compose_tag remaps them reversibly into the TOP
+    of the 32-bit composed-tag field. Cached: this sits on the
+    per-operation wire path and the service thread's poll loop."""
     global _WIN_BAND_CACHE
     if _WIN_BAND_CACHE is None:
-        from ..comm import _WIN_SLICE, _win_tag_base
+        from ..comm import _WIN_SLICE, _part_tag_base, _win_tag_base
 
-        lo = _win_tag_base()
-        _WIN_BAND_CACHE = (lo, lo + _WIN_SLICE)
+        lo = _part_tag_base()
+        hi = _win_tag_base() + _WIN_SLICE
+        _WIN_BAND_CACHE = (lo, hi)
     return _WIN_BAND_CACHE
 
 
@@ -95,8 +96,9 @@ def _compose_tag(src: int, dst: int, tag: int) -> int:
         tag = (_MAX_TAG - (win_hi - win_lo)) + (tag - win_lo)
     elif not 0 <= tag < _MAX_TAG - (win_hi - win_lo):
         raise MpiError(
-            f"mpi_tpu: cross-host tags must be in [0, 2**32 - 2**20) "
-            f"(the top 2**20 is the RMA window-service band), got {tag}")
+            f"mpi_tpu: cross-host tags must be in [0, 2**32 - 2**21) "
+            f"(the top 2**21 is the partitioned-p2p + RMA "
+            f"window-service band), got {tag}")
     return _XHOST_BIT | (src << 47) | (dst << 32) | tag
 
 

@@ -58,7 +58,8 @@ if TYPE_CHECKING:
 
 from .api import Interface, MpiError, Request, exchange as _exchange
 
-__all__ = ["Comm", "CartComm", "Message", "cart_create", "comm_world",
+__all__ = ["Comm", "CartComm", "Message", "PartitionedRecv",
+           "PartitionedSend", "cart_create", "comm_world",
            "comm_self", "SELF_CTX", "CTX_SPAN",
            "USER_TAG_SPAN"]
 
@@ -76,6 +77,14 @@ _NEIGHBOR_SLICE = 1 << 20
 # slice's first tag — the ONE definition window.py and the hybrid
 # driver's cross-host remap both build on.
 _WIN_SLICE = 1 << 20
+# MPI-4 partitioned point-to-point ships each partition as its own
+# tagged message from the slice directly below the window slice
+# (tag*_MAX_PARTITIONS + i; see Comm.psend_init). The hybrid driver's
+# cross-host remap covers this slice together with the window slice
+# (they are contiguous by construction).
+_PART_SLICE = 1 << 20
+_MAX_PARTITIONS = 64
+_PART_USER_TAGS = _PART_SLICE // _MAX_PARTITIONS  # user tags < 2^14
 
 
 def _win_tag_base() -> int:
@@ -83,6 +92,10 @@ def _win_tag_base() -> int:
 
     return COLL_TAG_BASE + (CTX_SPAN - USER_TAG_SPAN - _NEIGHBOR_SLICE
                             - _WIN_SLICE)
+
+
+def _part_tag_base() -> int:
+    return _win_tag_base() - _PART_SLICE
 # Context numbering: negotiated contexts grow monotonically from 1 and
 # can never plausibly reach the top of the space, so the topmost
 # _CREATE_GROUP_TAGS contexts are reserved as create_group's bootstrap
@@ -335,6 +348,69 @@ class Comm:
             self.iprobe, self.receive, self.cancel_receive,
             self.rank(), self.size(), tag, timeout, "Comm.receive_any")
 
+    # -- partitioned point-to-point (MPI-4 MPI_Psend_init family) ----------
+
+    def _part_check(self, buf, partitions: int, tag: int):
+        import numpy as np
+
+        arr = np.asarray(buf)
+        if arr.ndim != 1:
+            raise MpiError(
+                f"mpi_tpu: partitioned buffers are 1-D arrays, got "
+                f"shape {arr.shape}")
+        if not 1 <= partitions <= _MAX_PARTITIONS:
+            raise MpiError(
+                f"mpi_tpu: partitions must be in [1, {_MAX_PARTITIONS}]"
+                f", got {partitions}")
+        if arr.shape[0] % partitions:
+            raise MpiError(
+                f"mpi_tpu: buffer of {arr.shape[0]} elements does not "
+                f"split into {partitions} equal partitions")
+        if not 0 <= tag < _PART_USER_TAGS:
+            raise MpiError(
+                f"mpi_tpu: partitioned tag must be in "
+                f"[0, {_PART_USER_TAGS}), got {tag}")
+        return arr
+
+    def psend_init(self, buf, partitions: int, dest: int,
+                   tag: int = 0) -> "PartitionedSend":
+        """Persistent partitioned send (MPI-4 MPI_Psend_init): ``buf``
+        (1-D numpy array, ``partitions`` equal chunks) ships chunk by
+        chunk — ``start()`` opens an iteration, ``pready(i)`` marks
+        partition i final (it ships immediately, overlapping the
+        producer's remaining work), ``wait()`` completes the
+        iteration. Restart with ``start()`` — the persistent-request
+        model. The matching ``precv_init`` must use the same
+        ``partitions`` and ``tag``. A numpy array is REQUIRED: the
+        producer writes into it between start() and each pready(), so
+        a detached copy (what np.asarray makes of a list) would
+        silently ship stale init-time contents forever."""
+        import numpy as np
+
+        self._check_peer(dest)
+        if not isinstance(buf, np.ndarray):
+            raise MpiError(
+                "mpi_tpu: psend_init needs a numpy array (partitions "
+                "are read from it at each pready)")
+        arr = self._part_check(buf, partitions, tag)
+        return PartitionedSend(self, arr, partitions, dest, tag)
+
+    def precv_init(self, buf, partitions: int, source: int,
+                   tag: int = 0) -> "PartitionedRecv":
+        """Persistent partitioned receive (MPI_Precv_init): partitions
+        land in ``buf`` (written through) as they arrive;
+        ``parrived(i)`` tests one without blocking, ``wait()`` blocks
+        for the full buffer."""
+        import numpy as np
+
+        self._check_peer(source)
+        arr = self._part_check(buf, partitions, tag)
+        if not isinstance(buf, np.ndarray):
+            raise MpiError(
+                "mpi_tpu: precv_init needs a writable numpy array "
+                "(partitions are written through)")
+        return PartitionedRecv(self, arr, partitions, source, tag)
+
     # -- matched probe (MPI_Mprobe / MPI_Improbe) --------------------------
 
     def mprobe(self, source: Optional[int], tag: int,
@@ -461,12 +537,12 @@ class Comm:
     def _coll_seq(self, value: int) -> None:
         from .collectives_generic import _TAGS_PER_COLLECTIVE
 
-        # Cap the generic sequence below the neighborhood + window
-        # slices at the top of the collective offset space: allocation-
-        # time exhaustion beats a silently mis-routed halo or RMA
-        # service tag ~4e9 collectives later.
+        # Cap the generic sequence below the neighborhood + window +
+        # partitioned slices at the top of the collective offset space:
+        # allocation-time exhaustion beats a silently mis-routed halo
+        # or RMA service tag ~4e9 collectives later.
         limit = (CTX_SPAN - USER_TAG_SPAN - _NEIGHBOR_SLICE
-                 - _WIN_SLICE) // _TAGS_PER_COLLECTIVE
+                 - _WIN_SLICE - _PART_SLICE) // _TAGS_PER_COLLECTIVE
         if value >= limit:
             raise MpiError(
                 "mpi_tpu: communicator collective tag space exhausted")
@@ -779,6 +855,122 @@ class Message:
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         state = "consumed" if self._taken else "pending"
         return f"Message(source={self.source}, tag={self.tag}, {state})"
+
+
+class _PartitionedOp:
+    """Shared state machine for the partitioned send/receive pair.
+    Partition i of user tag t travels as its own message on synthetic
+    tag ``_part_tag_base() + t * _MAX_PARTITIONS + i``; iterations are
+    serialized by wait() on both sides (the sender's rendezvous acks
+    mean iteration n is fully received before n+1's first pready can
+    complete), so the same tags are safely reused every iteration."""
+
+    def __init__(self, comm: Comm, arr, partitions: int, peer: int,
+                 tag: int):
+        self._comm = comm
+        self._arr = arr
+        self._n = partitions
+        self._peer = peer
+        self._chunk = arr.shape[0] // partitions
+        self._base = _part_tag_base() + tag * _MAX_PARTITIONS
+        self._active = False
+
+    @property
+    def partitions(self) -> int:
+        return self._n
+
+    @property
+    def active(self) -> bool:
+        """True while an iteration is open (between start and wait)."""
+        return self._active
+
+    def _slice(self, i: int):
+        if not 0 <= i < self._n:
+            raise MpiError(
+                f"mpi_tpu: partition {i} out of range [0, {self._n})")
+        return self._arr[i * self._chunk:(i + 1) * self._chunk]
+
+    def _require_active(self, what: str) -> None:
+        if not self._active:
+            raise MpiError(
+                f"mpi_tpu: {what} outside an iteration — call start() "
+                f"first (persistent-request model)")
+
+
+class PartitionedSend(_PartitionedOp):
+    def start(self) -> None:
+        if self._active:
+            raise MpiError(
+                "mpi_tpu: PartitionedSend.start before the previous "
+                "iteration's wait()")
+        self._active = True
+        self._ready: set = set()
+        self._reqs: List[Request] = []
+
+    def pready(self, partition: int) -> None:
+        """Partition ``partition`` is final — ship it now
+        (MPI_Pready). The buffer slice is snapshotted, so the producer
+        may immediately reuse it."""
+        self._require_active("pready")
+        if partition in self._ready:
+            raise MpiError(
+                f"mpi_tpu: pready({partition}) twice in one iteration")
+        data = self._slice(partition).copy()
+        self._ready.add(partition)
+        self._reqs.append(self._comm.isend(
+            data, self._peer, self._base + partition))
+
+    def pready_range(self, lo: int, hi: int) -> None:
+        """MPI_Pready_range: ``pready`` for every partition in
+        [lo, hi] (MPI's inclusive convention)."""
+        for i in range(lo, hi + 1):
+            self.pready(i)
+
+    def wait(self) -> None:
+        """Complete the iteration: every partition must be pready and
+        acked by the receiver. The request restarts with start()."""
+        self._require_active("wait")
+        if len(self._ready) != self._n:
+            raise MpiError(
+                f"mpi_tpu: PartitionedSend.wait with only "
+                f"{len(self._ready)}/{self._n} partitions pready")
+        for r in self._reqs:
+            r.wait()
+        self._active = False
+
+
+class PartitionedRecv(_PartitionedOp):
+    def start(self) -> None:
+        if self._active:
+            raise MpiError(
+                "mpi_tpu: PartitionedRecv.start before the previous "
+                "iteration's wait()")
+        self._active = True
+        self._done: set = set()
+
+    def parrived(self, partition: int) -> bool:
+        """True once partition ``partition`` has landed in the buffer
+        (MPI_Parrived); claims it from the wire on first success."""
+        self._require_active("parrived")
+        if partition in self._done:
+            return True
+        self._slice(partition)  # range check
+        if not self._comm.iprobe(self._peer, self._base + partition):
+            return False
+        self._comm.receive(self._peer, self._base + partition,
+                           out=self._slice(partition))
+        self._done.add(partition)
+        return True
+
+    def wait(self) -> None:
+        """Block until every partition has landed in the buffer."""
+        self._require_active("wait")
+        for i in range(self._n):
+            if i not in self._done:
+                self._comm.receive(self._peer, self._base + i,
+                                   out=self._slice(i))
+                self._done.add(i)
+        self._active = False
 
 
 class CartComm(Comm):

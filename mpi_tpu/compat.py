@@ -266,6 +266,48 @@ class _AnySourceRequest(Request):
     Wait = wait
 
 
+class Prequest(Request):
+    """mpi4py ``MPI.Prequest`` over the native partitioned send/recv
+    (MPI-4 partitioned communication). A :class:`Request` subclass, as
+    in mpi4py, so the set operations accept it — Waitall on a
+    Prequest completes its current iteration."""
+
+    def __init__(self, native):
+        # The trivial inner request keeps Waitall/Waitany's parallel
+        # join happy; the REAL completion is this wrapper's Wait().
+        super().__init__(api.Request(lambda: None))
+        self._p = native
+
+    def Start(self) -> None:
+        self._p.start()
+
+    def Pready(self, partition: int) -> None:
+        self._p.pready(partition)
+
+    def Pready_range(self, lo: int, hi: int) -> None:
+        self._p.pready_range(lo, hi)
+
+    def Parrived(self, partition: int) -> bool:
+        return self._p.parrived(partition)
+
+    def Wait(self, status: Optional[Status] = None) -> None:
+        """Complete the open iteration; a no-op when none is open
+        (MPI: waiting an inactive persistent request returns
+        immediately — this is what lets Waitall mix Prequests with
+        ordinary requests)."""
+        if self._p.active:
+            self._p.wait()
+
+    wait = Wait
+
+    def Test(self) -> bool:
+        """Complete iff no iteration is open (MPI: a started
+        partitioned request completes at Wait)."""
+        return not self._p.active
+
+    test = Test
+
+
 class _FillOnWaitRequest(Request):
     """Uppercase ``Irecv``: completion must land the payload in the
     caller's buffer (and run any datatype unpack), so ``wait`` routes
@@ -446,6 +488,23 @@ class Comm:
     # mpi4py exposes both spellings (probe == Probe etc.).
     Probe = probe
     Iprobe = iprobe
+
+    # -- partitioned p2p (MPI-4 MPI_Psend_init family) ----------------------
+
+    def Psend_init(self, buf: Any, partitions: int, dest: int,
+                   tag: int = 0) -> "Prequest":
+        """Persistent partitioned send (MPI_Psend_init): Start() opens
+        an iteration, Pready(i) ships partition i immediately
+        (overlapping the producer's remaining work), Wait() completes;
+        then Start() again."""
+        return Prequest(self._c.psend_init(
+            np.asarray(buf), int(partitions), dest, tag))
+
+    def Precv_init(self, buf: Any, partitions: int, source: int,
+                   tag: int = 0) -> "Prequest":
+        return Prequest(self._c.precv_init(
+            _writable_buffer(buf, "Precv_init"), int(partitions),
+            source, tag))
 
     # -- matched probe (MPI_Mprobe family) ----------------------------------
 
@@ -2268,6 +2327,7 @@ class _MPI:
     MAX = Op("max")
     Status = Status
     Request = Request
+    Prequest = Prequest
     Comm = Comm
     Message = Message
     Info = Info

@@ -930,3 +930,110 @@ class TestMatchedProbe:
 
         res = run_spmd(main, n=3)
         assert res[0] == [10, 20]
+
+
+class TestPartitioned:
+    """MPI-4 partitioned point-to-point."""
+
+    def test_out_of_order_pready_and_iterations(self):
+        def main():
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            n_parts, chunk = 4, 8
+            if r == 0:
+                import numpy as np
+
+                buf = np.zeros(n_parts * chunk, np.float64)
+                ps = w.psend_init(buf, n_parts, dest=1, tag=3)
+                outs = []
+                for it in range(3):   # persistent: restart each time
+                    buf[:] = np.arange(n_parts * chunk) + 1000 * it
+                    ps.start()
+                    for i in (2, 0, 3, 1):   # out of order
+                        ps.pready(i)
+                    ps.wait()
+                    outs.append(True)
+                out = outs
+            else:
+                import numpy as np
+
+                landing = np.zeros(n_parts * chunk, np.float64)
+                pr = w.precv_init(landing, n_parts, source=0, tag=3)
+                sums = []
+                for it in range(3):
+                    pr.start()
+                    pr.wait()
+                    expect = np.arange(n_parts * chunk) + 1000 * it
+                    assert np.array_equal(landing, expect), it
+                    sums.append(float(landing.sum()))
+                out = sums
+            mpi_tpu.finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[0] == [True] * 3 and len(res[1]) == 3
+
+    def test_parrived_overlap(self):
+        def main():
+            import numpy as np
+
+            mpi_tpu.init()
+            w = comm_world()
+            r = w.rank()
+            if r == 0:
+                buf = np.arange(6, dtype=np.float64)
+                ps = w.psend_init(buf, 3, dest=1, tag=4)
+                ps.start()
+                ps.pready(1)          # only the middle partition first
+                w.barrier()
+                w.barrier()           # receiver checked parrived
+                ps.pready_range(2, 2)
+                ps.pready(0)
+                ps.wait()
+                out = True
+            else:
+                landing = np.zeros(6, np.float64)
+                pr = w.precv_init(landing, 3, source=0, tag=4)
+                pr.start()
+                w.barrier()
+                # Partition 1 is shipped; 0 is not.
+                got1 = False
+                for _ in range(2000):
+                    if pr.parrived(1):
+                        got1 = True
+                        break
+                    import time
+                    time.sleep(0.001)
+                assert got1 and not pr.parrived(0)
+                w.barrier()
+                pr.wait()
+                assert landing.tolist() == [0, 1, 2, 3, 4, 5]
+                out = True
+            mpi_tpu.finalize()
+            return out
+
+        assert all(run_spmd(main, n=2))
+
+    def test_errors(self):
+        def main():
+            import numpy as np
+
+            mpi_tpu.init()
+            w = comm_world()
+            buf = np.zeros(8, np.float64)
+            ps = w.psend_init(buf, 4, dest=w.rank(), tag=5)
+            try:
+                ps.pready(0)
+                out1 = "no error"
+            except mpi_tpu.MpiError as e:
+                out1 = "start()" in str(e)
+            try:
+                w.psend_init(np.zeros(7), 4, dest=0)
+                out2 = "no error"
+            except mpi_tpu.MpiError as e:
+                out2 = "equal partitions" in str(e)
+            mpi_tpu.finalize()
+            return out1, out2
+
+        assert all(o == (True, True) for o in run_spmd(main, n=2))

@@ -1545,3 +1545,53 @@ class TestLowercaseTestall:
         res = run_spmd(main, n=2)
         for r, msgs in enumerate(res):
             assert msgs == [r, r]
+
+
+class TestPartitionedCompat:
+    def test_psend_precv_prequest(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            if r == 0:
+                buf = np.arange(12, dtype=np.float64)
+                req = comm.Psend_init(buf, 3, dest=1, tag=2)
+                req.Start()
+                req.Pready_range(0, 1)
+                req.Pready(2)
+                req.Wait()
+                out = True
+            else:
+                landing = np.zeros(12, np.float64)
+                req = comm.Precv_init(landing, 3, source=0, tag=2)
+                req.Start()
+                req.Wait()
+                out = landing.tolist()
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[0] is True and res[1] == list(map(float, range(12)))
+
+    def test_prequest_in_request_sets(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            if r == 0:
+                buf = np.arange(4, dtype=np.float64)
+                req = comm.Psend_init(buf, 2, dest=1, tag=8)
+                req.Start()
+                req.Pready_range(0, 1)
+                MPI.Request.Waitall([req])     # set op accepts it
+                assert req.Test()
+                out = True
+            else:
+                landing = np.zeros(4, np.float64)
+                req = comm.Precv_init(landing, 2, source=0, tag=8)
+                req.Start()
+                MPI.Request.Waitall([req])
+                out = landing.tolist()
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[0] is True and res[1] == [0.0, 1.0, 2.0, 3.0]
