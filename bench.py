@@ -1224,6 +1224,32 @@ def main() -> int:
             "value": 0.0 if mfu is None else mfu, "unit": "pct",
             "vs_baseline": 0.0 if mfu is None
             else round(mfu / MFU_BASELINE_PCT, 3)}
+    if tpu_fallback:
+        # The last chip-measured headline, clearly labelled as prior
+        # provenance: the smoke MFU above measures the harness, not
+        # the framework, and must not read as a regression. Checked
+        # HERE (not at preflight) so a watcher capture landing while
+        # the CPU legs ran is still reported — and when the capture
+        # exists, it IS the latest provenance (the literals below are
+        # BASELINE.md's 2026-07-29 row, the fallback of the fallback).
+        prov = {"last_tpu_mfu_pct": 61.1,
+                "last_tpu_date": "2026-07-29",
+                "last_tpu_note": "manual v5e run; predates this "
+                                 "round's bf16-input kernel fix"}
+        manual = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_MANUAL_r03.json")
+        try:
+            with open(manual) as f:
+                rec = json.load(f)
+            if rec.get("platform") == "tpu" and rec.get("value"):
+                prov = {"last_tpu_mfu_pct": rec["value"],
+                        "last_tpu_date": "this round",
+                        "last_tpu_note": "tunnel-watcher capture"}
+            prov["manual_capture_file"] = os.path.basename(manual)
+        except (OSError, ValueError, KeyError):
+            pass  # no capture (or unreadable): keep the literals
+        tpu_fallback.update(prov)
     line.update(tpu_fallback)
     line.update(result)
     if watchdog is not None:
