@@ -19,7 +19,11 @@ get_accumulate/fetch_and_op execute synchronously at the target (so
 ``flush`` is a completed-by-construction ordering point), exclusive
 locks serialize read-modify-write sequences, and shared locks admit
 concurrent readers; waiters queue strictly FIFO (consecutive shared
-requests grant as a batch). ``locks`` defaults to False because the
+requests grant as a batch). The same service engine carries **PSCW**
+(:meth:`Window.post` / :meth:`Window.start` /
+:meth:`Window.complete` / :meth:`Window.wait` — generalized active
+target), completing all three MPI RMA synchronization modes.
+``locks`` defaults to False because the
 service thread polls the driver's ANY_SOURCE probe — the same
 latency/CPU tradeoff MPI implementations expose inverted via the
 ``no_locks`` info hint.
