@@ -177,13 +177,12 @@ def measure_train_step(d_model: int = 1024, n_layers: int = 8,
     if attention == "flash":
         from mpi_tpu.ops import tune_flash_blocks
 
-        # Winners persist across bench processes (a retried run after a
-        # tunnel drop skips the sweep); the candidate list is trimmed
-        # to 6 — each one costs a kernel compile through the tunnel.
-        os.environ.setdefault(
-            "MPI_TPU_TUNE_CACHE",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".flash_tune_cache.json"))
+        # Winners persist in the COMMITTED package cache
+        # (mpi_tpu/ops/flash_tune_cache.json, the autotune default):
+        # any run after a completed sweep — this process, a retry, a
+        # later round — skips tuning entirely. The candidate list is
+        # trimmed to 6; each one costs a kernel compile through the
+        # tunnel on a cache miss.
         try:
             best, table = tune_flash_blocks(
                 batch, seq, n_heads, d_model // n_heads, reps=2,
@@ -241,7 +240,7 @@ def measure_train_step(d_model: int = 1024, n_layers: int = 8,
     dev = jax.devices()[0]
     peak, peak_src = _peak_tflops(dev)
     achieved_tflops = flops / per_step / 1e12
-    return {
+    result = {
         "train_step_ms": round(per_step * 1e3, 3),
         "train_tokens_per_s": round(batch * seq / per_step),
         "train_achieved_tflops": round(achieved_tflops, 2),
@@ -259,6 +258,125 @@ def measure_train_step(d_model: int = 1024, n_layers: int = 8,
         "loss_first_step": round(loss_v, 4),
         **tuned,
     }
+    # Component split AFTER the headline is banked on stdout: the
+    # breakdown costs ~6 more jitted programs through the tunnel, and a
+    # hang there must cost the split, never the MFU (the leg parent
+    # salvages the last complete JSON line when it kills a timed-out
+    # child). Disable with MPI_TPU_BENCH_BREAKDOWN=0 (the
+    # --headline-only fast path does).
+    if os.environ.get("MPI_TPU_BENCH_BREAKDOWN", "1") != "0":
+        print(json.dumps(result), flush=True)
+        try:
+            result.update(_train_breakdown(cfg, state, batch, seq,
+                                           short, long, per_step * 1e3))
+        except Exception as exc:  # noqa: BLE001 - split is best-effort
+            result["train_breakdown_error"] = str(exc)[:200]
+    return result
+
+
+def _train_breakdown(cfg, state, batch: int, seq: int, short: int,
+                     long: int, step_ms: float) -> dict:
+    """Per-component device-time estimate for the train leg (VERDICT r3
+    weak#1: nobody can say where the non-MFU time goes). Components:
+
+    - ``attn``:  fwd+bwd of ONE layer's attention sub-block (the model's
+      own ``_attention`` — qkv/o projections + the selected kernel — at
+      the model's shapes, grads w.r.t. activations AND weights), scaled
+      by ``n_layers``.
+    - ``ffn``:   same for the FFN sub-block (gelu MLP).
+    - ``opt``:   one AdamW update on the full parameter tree.
+    - ``rest``:  ``step - (attn + ffn + opt)`` — embed/head matmuls,
+      layernorms, residuals, the loss, and fusion differences.
+
+    Each is its own scanned+differenced jitted program, so the
+    cross-component fusion the full step enjoys is NOT captured: the
+    split is a lever-finder, not an exact account (``rest`` can go
+    slightly negative when isolated programs fuse worse than the step;
+    reported as measured)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mpi_tpu.models import make_optimizer
+    from mpi_tpu.models.transformer import _attention, _ffn
+
+    blk = state["params"]["blocks"][0]
+    # Only the weights each sub-block actually reads: differentiating
+    # the WHOLE block dict would charge every component a full-tree
+    # read+write per scan step for parameters whose grads are zero
+    # (wq..wo traffic in the ffn timing and vice versa), inflating
+    # both splits identically and pushing `rest` spuriously negative.
+    ablk = {k: blk[k] for k in ("wq", "wk", "wv", "wo")}
+    fblk = ({"moe": blk["moe"]} if "moe" in blk
+            else {k: blk[k] for k in ("w1", "w2")})
+    x0 = jax.random.normal(jax.random.PRNGKey(7),
+                           (batch, seq, cfg.d_model), cfg.dtype)
+
+    def timed(body, carry0):
+        def steps(n):
+            @jax.jit
+            def run(c):
+                c, _ = lax.scan(body, c, None, length=n)
+                return c
+            return run
+        rs, rl = steps(short), steps(long)
+        jax.block_until_ready(rs(carry0))
+        jax.block_until_ready(rl(carry0))
+        per, _ = _differenced(
+            lambda: jax.block_until_ready(rs(carry0)),
+            lambda: jax.block_until_ready(rl(carry0)), short, long)
+        return per
+
+    def evolve(c, g, eps=1e-6):
+        # Fold the grads back into the carry so the scan has a real
+        # data dependence step-to-step (nothing dead-code-eliminates)
+        # while staying numerically tame.
+        return jax.tree.map(
+            lambda a, b: a + eps * b.astype(a.dtype), c, g)
+
+    attn_grad = jax.grad(
+        lambda x, b: jnp.sum(
+            _attention(x, b, cfg, None).astype(jnp.float32)),
+        argnums=(0, 1))
+
+    def attn_body(c, _):
+        x, b = c
+        gx, gb = attn_grad(x, b)
+        return (evolve(x, gx), evolve(b, gb)), ()
+
+    ffn_grad = jax.grad(
+        lambda x, b: jnp.sum(_ffn(x, b, cfg, None)[0]
+                             .astype(jnp.float32)), argnums=(0, 1))
+
+    def ffn_body(c, _):
+        x, b = c
+        gx, gb = ffn_grad(x, b)
+        return (evolve(x, gx), evolve(b, gb)), ()
+
+    opt = make_optimizer("adamw", 1e-3)
+    fake_grads = jax.tree.map(
+        lambda p: jnp.full_like(p, 1e-4), state["params"])
+
+    def opt_body(c, _):
+        import optax
+        params, opt_state = c
+        updates, opt_state = opt.update(fake_grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state), ()
+
+    out: dict = {}
+    attn_ms = timed(attn_body, (x0, ablk)) * 1e3 * cfg.n_layers
+    out["train_breakdown_attn_ms"] = round(attn_ms, 3)
+    ffn_ms = timed(ffn_body, (x0, fblk)) * 1e3 * cfg.n_layers
+    out["train_breakdown_ffn_ms"] = round(ffn_ms, 3)
+    opt_ms = timed(opt_body, (state["params"], state["opt"])) * 1e3
+    out["train_breakdown_opt_ms"] = round(opt_ms, 3)
+    rest_ms = step_ms - attn_ms - ffn_ms - opt_ms
+    out["train_breakdown_rest_ms"] = round(rest_ms, 3)
+    for name, ms in (("attn", attn_ms), ("ffn", ffn_ms),
+                     ("opt", opt_ms), ("rest", rest_ms)):
+        out[f"train_breakdown_{name}_pct"] = round(
+            100.0 * ms / step_ms, 1) if step_ms > 0 else None
+    return out
 
 
 def measure_long_context(seq: int = 8192, d_model: int = 1024,
@@ -928,9 +1046,16 @@ def _run_device_leg(name: str, timeout_s: float, smoke: bool,
             sys.stderr.write(err)  # full traceback into the round log
         lines = (err or "").strip().splitlines()
         tail = lines[-1][:200] if lines else ""
-        return {f"{name}_error":
-                f"leg timed out after {timeout_s:.0f}s (device/tunnel "
-                f"hang); killed. last stderr: {tail}"}
+        rec = {f"{name}_error":
+               f"leg timed out after {timeout_s:.0f}s (device/tunnel "
+               f"hang); killed. last stderr: {tail}"}
+        # Salvage anything the child banked before hanging — the train
+        # leg flushes its headline keys before the breakdown's extra
+        # compiles, so a mid-breakdown tunnel drop still yields the MFU.
+        banked = _last_json(out)
+        if banked is not None:
+            rec.update(banked)
+        return rec
     if err:
         sys.stderr.write(err)  # leg logs flow into the round log
     if proc.returncode != 0:
@@ -972,6 +1097,76 @@ def _device_preflight(timeout_s: float = 300.0):
 _PARTIALS: dict = {}
 
 
+# Stdout-line whitelist, importance-ordered. The driver parses the one
+# stdout JSON line from a bounded capture window: BENCH_r03's 65-key
+# ~4 KB line overflowed it and the round recorded `parsed: null`
+# (VERDICT r3 weak#6). The compact line carries the headline +
+# per-leg representative numbers and stays under _LINE_BUDGET bytes;
+# every key (curves, tune tables, model shapes, tier splits) lands in
+# the committed BENCH_FULL.json instead.
+_COMPACT_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "smoke", "mode",
+    "platform", "device_kind", "tpu_evidence", "tpu_unreachable",
+    "last_tpu_mfu_pct",
+    "train_step_ms", "train_tokens_per_s", "train_achieved_tflops",
+    "peak_tflops", "flash_block_q", "flash_block_k",
+    "train_breakdown_attn_pct", "train_breakdown_ffn_pct",
+    "train_breakdown_opt_pct", "train_breakdown_rest_pct",
+    "allreduce_256MiB_gbps", "allreduce_256MiB_busbw_gbps",
+    "allreduce_1MiB_busbw_gbps", "allreduce_32MiB_busbw_gbps",
+    "allreduce_1MiB_busbw_gbps_cpu8mesh",
+    "allreduce_32MiB_busbw_gbps_cpu8mesh",
+    "qallreduce_crossover_bytes",
+    "long_ctx_tokens_per_s", "long_ctx_mfu_pct",
+    "decode_tokens_per_s", "decode_int8_tokens_per_s",
+    "ssm_train_tokens_per_s", "ssm_decode_tokens_per_s",
+    "bounce_tcp_us", "bounce_shm_us", "bounce_xla_us",
+    "bounce_speedup", "bounce_device_us",
+    "hybrid_allreduce_1MiB_p50_us_4x8",
+    "timing_method", "loss_first_step", "error",
+)
+_LINE_BUDGET = 1600  # bytes; safely inside the driver's capture tail
+
+
+def _emit(full: dict) -> None:
+    """Write the complete result dict to ``BENCH_FULL.json`` and print
+    the compact headline-first JSON line to stdout (the one-line driver
+    contract). Key order in the compact line IS importance order, so if
+    a reader's window truncates anything it is the tail, never the
+    headline."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_FULL.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(full, f, indent=1)
+            f.write("\n")
+        full_note = os.path.basename(path)
+    except OSError as exc:  # compact line still appears
+        full_note = f"unwritable: {str(exc)[:80]}"
+    # The full-file pointer sits inside the protected head so trimming
+    # can never drop it (or push the line back over budget by
+    # re-adding it).
+    compact = {k: full[k] for k in _COMPACT_KEYS[:6] if k in full}
+    compact["full_results"] = full_note
+    for k in _COMPACT_KEYS[6:]:
+        if k in full:
+            compact[k] = full[k]
+    # Leg errors always surface (truncated) — they explain absent keys.
+    for k, v in full.items():
+        if k.endswith("_error") and k not in compact:
+            compact[k] = str(v)[:90]
+    s = json.dumps(compact)
+    if len(s) > _LINE_BUDGET:
+        # Trim tail-first (insertion order = importance order), but
+        # never the headline quadruple + provenance head.
+        keys = list(compact)
+        while len(s) > _LINE_BUDGET and len(keys) > 8:
+            compact.pop(keys.pop())
+            compact["truncated"] = True
+            s = json.dumps(compact)
+    print(s, flush=True)
+
+
 def _install_watchdog(seconds: float) -> threading.Timer:
     """Guarantee the one-JSON-line stdout contract even if the device
     hangs: a jax call stuck on an unresponsive TPU/tunnel blocks forever
@@ -988,7 +1183,7 @@ def _install_watchdog(seconds: float) -> threading.Timer:
                      f"device/tunnel unresponsive",
         }
         line.update(_PARTIALS)
-        print(json.dumps(line), flush=True)
+        _emit(line)
         os._exit(3)
 
     t = threading.Timer(seconds, fire)
@@ -1015,7 +1210,8 @@ def main() -> int:
         idx = sys.argv.index("--platform")
         if idx + 1 >= len(sys.argv):
             print("usage: bench.py [--platform NAME[:NUM_DEVICES]]"
-                  " [--suite]", file=sys.stderr)
+                  " [--suite] [--smoke] [--headline-only]",
+                  file=sys.stderr)
             return 2
         platform_arg = sys.argv[idx + 1]
         name, _, count = platform_arg.partition(":")
@@ -1029,6 +1225,17 @@ def main() -> int:
     # --smoke: tiny shapes so CI can exercise the full harness path on
     # CPU in seconds; the real run uses the defaults on the real chip.
     smoke = "--smoke" in sys.argv
+    # --headline-only: the tunnel-window fast path (VERDICT r3 item 1).
+    # One preflight probe, then ONLY the train-MFU leg — autotune
+    # winners come from the committed cache (or a short 120 s sweep on
+    # a cold cache), the compile cache is persistent, and the line is
+    # emitted the moment the leg returns. A 20-minute tunnel window
+    # yields the headline in its first minutes; run the full bench
+    # afterwards for the rest.
+    headline_only = "--headline-only" in sys.argv
+    if headline_only:
+        os.environ.setdefault("MPI_TPU_TUNE_DEADLINE_S", "120")
+        os.environ.setdefault("MPI_TPU_BENCH_BREAKDOWN", "0")
 
     if "--_device-leg" in sys.argv:
         # Child entry for one isolated device leg (after --platform so
@@ -1053,9 +1260,14 @@ def main() -> int:
         # budget.
         budget = 300.0 if deadline <= 0 else min(300.0, deadline / 2)
         per_probe = max(30.0, budget / 3)
+        attempts = 3
+        if headline_only:
+            # The watcher only invokes this path after its own probe
+            # succeeded; one probe suffices and the window is precious.
+            budget, per_probe, attempts = 120.0, 120.0, 1
         probe_deadline = time.monotonic() + budget
         ok, why = False, "no probe ran"
-        for attempt in range(3):
+        for attempt in range(attempts):
             remaining = probe_deadline - time.monotonic()
             if remaining <= 1.0:
                 break
@@ -1178,8 +1390,10 @@ def main() -> int:
                "decode": 400.0, "decode_int8": 350.0, "ssm": 450.0}
     if smoke:
         budgets = {k: min(v, 200.0) for k, v in budgets.items()}
-    for leg_name in ("train", "allreduce", "long_ctx", "decode",
-                     "decode_int8", "ssm"):
+    leg_names = ("train",) if headline_only else (
+        "train", "allreduce", "long_ctx", "decode", "decode_int8",
+        "ssm")
+    for leg_name in leg_names:
         if deadline_end is not None:
             remaining = deadline_end - time.monotonic() - 120.0
             if remaining < 45.0:
@@ -1205,56 +1419,63 @@ def main() -> int:
     # where BENCH_r01/r02 ran them on whatever backend the parent held.
     from mpi_tpu.utils.platform import force_platform
 
-    if platform_arg is None and not tpu_fallback:
-        force_platform("cpu", 8)
-        rec = {"host_legs_platform": "cpu:8"}
-        result.update(rec)
-        _PARTIALS.update(rec)
-    _leg("bounce", bounce_legs)
-    _leg("bounce_device",
-         lambda: bounce_device((1 << 14) if smoke else BOUNCE_SIZE))
-    # BASELINE config 5: the hierarchical two-tier engine at 32 ranks
-    # (4 hosts x 8 locals), in the default line.
-    _leg("hybrid_allreduce", measure_hybrid_allreduce)
-    if "--suite" in sys.argv:
-        _leg("sweep", lambda: allreduce_sweep() or {})
+    if not headline_only:
+        if platform_arg is None and not tpu_fallback:
+            force_platform("cpu", 8)
+            rec = {"host_legs_platform": "cpu:8"}
+            result.update(rec)
+            _PARTIALS.update(rec)
+        _leg("bounce", bounce_legs)
+        _leg("bounce_device",
+             lambda: bounce_device((1 << 14) if smoke else BOUNCE_SIZE))
+        # BASELINE config 5: the hierarchical two-tier engine at 32
+        # ranks (4 hosts x 8 locals), in the default line.
+        _leg("hybrid_allreduce", measure_hybrid_allreduce)
+        if "--suite" in sys.argv:
+            _leg("sweep", lambda: allreduce_sweep() or {})
 
     mfu = result.pop("mfu_pct", None)
     line = {"metric": "train_step_mfu",
             "value": 0.0 if mfu is None else mfu, "unit": "pct",
             "vs_baseline": 0.0 if mfu is None
-            else round(mfu / MFU_BASELINE_PCT, 3)}
+            else round(mfu / MFU_BASELINE_PCT, 3),
+            # VERDICT r3 item 7: a smoke line measures the harness at
+            # tiny shapes, not the framework — mark it unambiguously.
+            "smoke": bool(smoke),
+            "mode": "headline-only" if headline_only else "full"}
     if tpu_fallback:
         # The last chip-measured headline, clearly labelled as prior
         # provenance: the smoke MFU above measures the harness, not
         # the framework, and must not read as a regression. Checked
         # HERE (not at preflight) so a watcher capture landing while
-        # the CPU legs ran is still reported — and when the capture
-        # exists, it IS the latest provenance (the literals below are
-        # BASELINE.md's 2026-07-29 row, the fallback of the fallback).
+        # the CPU legs ran is still reported — newest capture wins;
+        # the literals are BASELINE.md's 2026-07-29 row, the fallback
+        # of the fallback.
         prov = {"last_tpu_mfu_pct": 61.1,
                 "last_tpu_date": "2026-07-29",
-                "last_tpu_note": "manual v5e run; predates this "
-                                 "round's bf16-input kernel fix"}
-        manual = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "BENCH_MANUAL_r03.json")
-        try:
-            with open(manual) as f:
-                rec = json.load(f)
+                "tpu_evidence": "r02 manual v5e run (BASELINE.md:53); "
+                                "predates the bf16-input kernel fix"}
+        for manual in ("BENCH_MANUAL_r04.json", "BENCH_MANUAL_r03.json"):
+            p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             manual)
+            try:
+                with open(p) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
             if rec.get("platform") == "tpu" and rec.get("value"):
                 prov = {"last_tpu_mfu_pct": rec["value"],
-                        "last_tpu_date": "this round",
-                        "last_tpu_note": "tunnel-watcher capture"}
-            prov["manual_capture_file"] = os.path.basename(manual)
-        except (OSError, ValueError, KeyError):
-            pass  # no capture (or unreadable): keep the literals
+                        "tpu_evidence": f"{manual} (tunnel-watcher "
+                                        f"capture, this round)"}
+                break
         tpu_fallback.update(prov)
+    elif result.get("platform") == "tpu":
+        line["tpu_evidence"] = "this run"
     line.update(tpu_fallback)
     line.update(result)
     if watchdog is not None:
         watchdog.cancel()
-    print(json.dumps(line))
+    _emit(line)
     return 0
 
 

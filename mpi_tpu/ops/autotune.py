@@ -49,12 +49,23 @@ DEFAULT_CANDIDATES: Tuple[Tuple[int, int], ...] = (
 _cache: Dict[tuple, Tuple[int, int]] = {}
 
 
+# Committed with the package: winners tuned on real hardware survive
+# not just across processes but across checkouts/rounds, so a short
+# device window spends its minutes measuring, never re-tuning.
+_DEFAULT_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "flash_tune_cache.json")
+
+
 def _disk_cache_path() -> Optional[str]:
-    """Optional cross-process cache file (MPI_TPU_TUNE_CACHE=path).
-    A TPU sweep costs one kernel compile per candidate — behind a slow
-    or flaky device tunnel that is minutes; persisting winners makes a
-    retried benchmark run free."""
-    return os.environ.get("MPI_TPU_TUNE_CACHE") or None
+    """Cross-process winner cache. Defaults to the committed
+    ``flash_tune_cache.json`` next to this module; override with
+    ``MPI_TPU_TUNE_CACHE=path`` or disable with ``MPI_TPU_TUNE_CACHE=``
+    (empty). A TPU sweep costs one kernel compile per candidate —
+    behind a slow or flaky device tunnel that is minutes; persisting
+    winners makes every later run free."""
+    if "MPI_TPU_TUNE_CACHE" in os.environ:
+        return os.environ["MPI_TPU_TUNE_CACHE"] or None
+    return _DEFAULT_CACHE
 
 
 def _disk_cache_load(key: tuple) -> Optional[Tuple[int, int]]:

@@ -174,9 +174,10 @@ def test_flash_gqa_rejects_indivisible_heads():
 class TestAutotune:
     @pytest.fixture(autouse=True)
     def _no_ambient_disk_cache(self, monkeypatch):
-        # An inherited MPI_TPU_TUNE_CACHE would satisfy sweeps from
-        # disk and break the table-shape assertions below.
-        monkeypatch.delenv("MPI_TPU_TUNE_CACHE", raising=False)
+        # The committed default cache (or an inherited
+        # MPI_TPU_TUNE_CACHE) would satisfy sweeps from disk and break
+        # the table-shape assertions below; empty = disabled.
+        monkeypatch.setenv("MPI_TPU_TUNE_CACHE", "")
 
     def _shape(self):
         return dict(batch=2, seq=64, heads=2, head_dim=16)
