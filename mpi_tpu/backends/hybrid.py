@@ -46,6 +46,7 @@ if TYPE_CHECKING:
 
 from .. import collectives_generic as G
 from ..api import MpiError
+from ..utils import trace
 from .tcp import TcpNetwork
 from .xla import XlaNetwork, drive_rank_threads
 
@@ -481,9 +482,21 @@ class _HybridGroupEngine:
             # fold it in the canonical tree instead (same order as every
             # other driver).
             return G.tree_combine(self.allgather(data), op)
-        local_total = self._inner.allreduce(data, op=op)
-        return self._leader_leg(
-            local_total, lambda t: G.allreduce(self._tcp_grp, t, op=op))
+        # Inlined _leader_leg with a trace span per tier: the three
+        # phases hide behind one opaque latency otherwise, and a
+        # regression in the DCN-analogue leader tier would be
+        # indistinguishable from local noise (bench reads these spans;
+        # span() is a one-bool check when tracing is off).
+        with trace.span("hybrid.allreduce.local_reduce"):
+            local_total = self._inner.allreduce(data, op=op)
+        if len(self._hosts) == 1:
+            return local_total
+        out = None
+        if self._is_leader():
+            with trace.span("hybrid.allreduce.leader_exchange"):
+                out = G.allreduce(self._tcp_grp, local_total, op=op)
+        with trace.span("hybrid.allreduce.local_bcast"):
+            return self._inner.bcast(out, root=0)
 
     def reduce(self, data: Any, root: int = 0, op: "OpLike" = "sum"
                ) -> Optional[Any]:

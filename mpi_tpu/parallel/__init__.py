@@ -32,8 +32,9 @@ from .ring_attention import (
 from .halo import halo_exchange, jacobi_step_1d, jacobi_step_2d
 from .pipeline import pipeline, pipeline_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
-from .quantized import (dequantize_blocks, quantize_blocks,
-                        quantized_allreduce)
+from .quantized import (QUANTIZED_MIN_BYTES, allreduce_compressed,
+                        dequantize_blocks, quantize_blocks,
+                        quantized_allreduce, quantized_eligible)
 from .cache_parallel import (cache_parallel_decode_attention,
                              merge_decode_partials)
 from .zero import (constrain_opt_state, constrain_params, fsdp_specs,
@@ -41,6 +42,9 @@ from .zero import (constrain_opt_state, constrain_params, fsdp_specs,
 
 __all__ = [
     "quantized_allreduce",
+    "quantized_eligible",
+    "allreduce_compressed",
+    "QUANTIZED_MIN_BYTES",
     "quantize_blocks",
     "dequantize_blocks",
     "make_mesh",

@@ -4,7 +4,12 @@ Large-payload allreduce is wire-bound: a float32 ring moves ``~2 x 4``
 bytes per element. Quantizing each leg to int8 with per-block float32
 scales moves ``~2 x 1`` bytes (+ 1/block overhead) — a ~4x busbw
 improvement wherever the interconnect, not the VPU, is the bottleneck
-(DCN-crossing data parallelism above all). The technique follows the
+(DCN-crossing data parallelism above all). Where the wire is NOT the
+bottleneck the compression is a straight loss (measured 3-10x slower
+than the exact path on an in-memory fabric) — use
+:func:`allreduce_compressed`, which applies the measured
+:func:`quantized_eligible` gate and never loses to plain allreduce,
+rather than calling :func:`quantized_allreduce` directly. The technique follows the
 published quantized-allreduce design space (blockwise amax scaling,
 quantize-per-phase — see PAPERS.md: EQuARX); the implementation is
 XLA-native: one ``all_to_all`` + one ``all_gather``, both riding
@@ -39,7 +44,49 @@ from jax import lax
 
 from .mesh import RANK_AXIS
 
-__all__ = ["quantized_allreduce", "quantize_blocks", "dequantize_blocks"]
+__all__ = ["quantized_allreduce", "quantize_blocks", "dequantize_blocks",
+           "quantized_eligible", "allreduce_compressed",
+           "QUANTIZED_MIN_BYTES"]
+
+# Measured dispatch gate (mirrors ``collectives_generic.ring_eligible``'s
+# measured-crossover discipline): the compression only pays where the
+# WIRE is the bottleneck, and below the crossover the extra
+# quantize/dequantize compute is a straight regression — BENCH_r03
+# recorded the forced path 8.6x slower than plain allreduce at 1 MiB on
+# the virtual CPU mesh.
+#
+# fabric -> minimum payload bytes where int8+scales beats float32
+# (None = never):
+#   "cpu"  — measured 2026-07-31 on the 8-device virtual CPU mesh:
+#            quantized was 3-10x SLOWER at every size from 1 MiB to
+#            128 MiB (ratio shrinking with size but never crossing) —
+#            an in-memory "fabric" has no bandwidth shortage for the
+#            compression to buy back.
+#   "tpu"  — provisional 64 MiB: ICI busbw is high enough that only
+#            very large, bandwidth-bound payloads can win; unmeasured
+#            on multi-chip hardware (single-chip box — a 1-device axis
+#            has no collective), so the gate errs conservative. Re-run
+#            the bench sweep on a pod slice to replace this constant.
+#   "dcn"  — 1 MiB: cross-host links are the design target (EQuARX,
+#            PAPERS.md) — wire-bound from small sizes; the hybrid
+#            driver's leader tier is the in-repo analogue.
+QUANTIZED_MIN_BYTES = {
+    "cpu": None,
+    "tpu": 64 << 20,
+    "dcn": 1 << 20,
+}
+
+
+def quantized_eligible(nbytes: int, fabric: str | None = None) -> bool:
+    """True when an int8-compressed allreduce of ``nbytes`` is expected
+    to beat the exact float path on ``fabric`` (``"cpu"``/``"tpu"``/
+    ``"dcn"``; default: the current JAX backend). The thresholds are
+    measured (or explicitly provisional) constants —
+    see ``QUANTIZED_MIN_BYTES``."""
+    if fabric is None:
+        fabric = jax.default_backend()
+    threshold = QUANTIZED_MIN_BYTES.get(fabric)
+    return threshold is not None and nbytes >= threshold
 
 
 def quantize_blocks(x: jnp.ndarray, block: int):
@@ -104,3 +151,22 @@ def quantized_allreduce(x: jnp.ndarray, axis_name: str = RANK_AXIS,
     gs = lax.all_gather(s2, axis_name, axis=0, tiled=True)
     full = dequantize_blocks(gq, gs)[:m]
     return full.reshape(shape).astype(dtype)
+
+
+def allreduce_compressed(x: jnp.ndarray, axis_name: str = RANK_AXIS,
+                         block: int = 1024,
+                         fabric: str | None = None) -> jnp.ndarray:
+    """Size/fabric-dispatched allreduce: int8-compressed wire traffic
+    when :func:`quantized_eligible` says the payload is big enough to
+    be wire-bound on this fabric, the exact float path otherwise — so
+    the recommended call never loses to plain
+    :func:`.collectives.allreduce` at any size. Call inside
+    ``shard_map`` like both underlying paths. The dispatch is on the
+    STATIC payload size at trace time (no runtime branch under jit)."""
+    nbytes = x.size * jnp.dtype(x.dtype).itemsize
+    if jnp.issubdtype(x.dtype, jnp.floating) \
+            and quantized_eligible(int(nbytes), fabric):
+        return quantized_allreduce(x, axis_name, block)
+    from .collectives import allreduce
+
+    return allreduce(x, axis_name)

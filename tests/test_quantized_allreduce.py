@@ -127,3 +127,101 @@ def test_integer_dtype_rejected():
         jax.jit(body)(jax.device_put(
             jnp.ones((8, 1, 64), jnp.int32),
             NamedSharding(mesh, P("rank"))))
+
+
+class TestDispatchGate:
+    """quantized_eligible / allreduce_compressed (VERDICT r3 item 4):
+    the recommended path must never lose to plain allreduce — on an
+    in-memory fabric the gate says never, on DCN it opens at 1 MiB,
+    and the dispatcher's output is bitwise-exact whenever the gate
+    keeps the exact path."""
+
+    def test_gate_constants(self):
+        from mpi_tpu.parallel import (QUANTIZED_MIN_BYTES,
+                                      quantized_eligible)
+
+        # cpu: measured never (3-10x slower at 1 MiB..128 MiB).
+        assert QUANTIZED_MIN_BYTES["cpu"] is None
+        assert not quantized_eligible(1 << 30, fabric="cpu")
+        # dcn: wire-bound from 1 MiB.
+        assert quantized_eligible(1 << 20, fabric="dcn")
+        assert not quantized_eligible((1 << 20) - 1, fabric="dcn")
+        # tpu: provisional large-payload-only threshold.
+        assert quantized_eligible(64 << 20, fabric="tpu")
+        assert not quantized_eligible(1 << 20, fabric="tpu")
+        # unknown fabric: fail closed (exact path).
+        assert not quantized_eligible(1 << 30, fabric="quantum")
+
+    def test_default_fabric_is_backend(self):
+        from mpi_tpu.parallel import quantized_eligible
+
+        # Tests run on the cpu backend (conftest): default = never.
+        assert jax.default_backend() == "cpu"
+        assert not quantized_eligible(1 << 30)
+
+    def test_compressed_dispatch_exact_when_gated_off(self):
+        """On the cpu fabric the dispatcher must produce the exact
+        allreduce result bit-for-bit (it never quantizes here)."""
+        from mpi_tpu.parallel import allreduce_compressed
+
+        n = 8
+        rng = np.random.default_rng(5)
+        xs = rng.standard_normal((n, 512)).astype(np.float32)
+        mesh = make_mesh(n)
+        body = jax.shard_map(
+            lambda v: allreduce_compressed(v[0], "rank")[None],
+            mesh=mesh, in_specs=P("rank"), out_specs=P("rank"),
+            check_vma=False)
+        got = np.asarray(jax.jit(body)(jax.device_put(
+            jnp.asarray(xs), NamedSharding(mesh, P("rank")))))
+
+        from mpi_tpu.parallel import collectives as C
+        exact_body = jax.shard_map(
+            lambda v: C.allreduce(v[0], "rank")[None],
+            mesh=mesh, in_specs=P("rank"), out_specs=P("rank"),
+            check_vma=False)
+        want = np.asarray(jax.jit(exact_body)(jax.device_put(
+            jnp.asarray(xs), NamedSharding(mesh, P("rank")))))
+        np.testing.assert_array_equal(got, want)
+
+    def test_compressed_dispatch_quantizes_when_eligible(self):
+        """Forcing the dcn fabric at an eligible size routes through
+        the lossy path (result within the quantization error bound,
+        not bitwise equal to the input sum in general, and both code
+        paths stay jit-compatible)."""
+        from mpi_tpu.parallel import allreduce_compressed
+
+        n = 8
+        # Eligibility is judged on the PER-CALL payload each rank
+        # reduces — a full 1 MiB vector per rank opens the dcn gate.
+        elems = (1 << 20) // 4
+        xs = np.full((n, elems), 1.0, np.float32)
+        mesh = make_mesh(n)
+        body = jax.shard_map(
+            lambda v: allreduce_compressed(v[0], "rank",
+                                           fabric="dcn")[None],
+            mesh=mesh, in_specs=P("rank"), out_specs=P("rank"),
+            check_vma=False)
+        got = np.asarray(jax.jit(body)(jax.device_put(
+            jnp.asarray(xs), NamedSharding(mesh, P("rank")))))
+        # Constant blocks quantize exactly: sum == 8.0 everywhere.
+        np.testing.assert_allclose(got, np.full((n, elems), 8.0),
+                                   rtol=1e-6)
+
+    def test_integer_payload_takes_exact_path(self):
+        """Integers must reduce exactly: the dispatcher routes them to
+        the exact allreduce even on a fabric where floats would
+        quantize."""
+        from mpi_tpu.parallel import allreduce_compressed
+
+        n = 8
+        xs = np.arange(n * 64, dtype=np.int32).reshape(n, 64)
+        mesh = make_mesh(n)
+        body = jax.shard_map(
+            lambda v: allreduce_compressed(v[0], "rank",
+                                           fabric="dcn")[None],
+            mesh=mesh, in_specs=P("rank"), out_specs=P("rank"),
+            check_vma=False)
+        got = np.asarray(jax.jit(body)(jax.device_put(
+            jnp.asarray(xs), NamedSharding(mesh, P("rank")))))
+        np.testing.assert_array_equal(got, np.tile(xs.sum(0), (n, 1)))
