@@ -463,12 +463,29 @@ class _HybridGroupEngine:
     def _is_leader(self) -> bool:
         return self._net.rank() == self._local_members[0]
 
-    def _leader_leg(self, local_result: Any,
-                    leg: Callable[[Any], Any]) -> Any:
+    def _leader_leg(self, local_result: Any, leg: Callable[[Any], Any],
+                    span_prefix: str = "") -> Any:
+        """Leader bridges hosts, result fans back out locally. With
+        ``span_prefix`` set, each phase records a trace span:
+        ``<p>.leader_exchange`` and ``<p>.local_bcast`` on the leader
+        (separately attributable costs — the leader enters its bcast
+        only after its exchange, so its bcast span is pure fan-out
+        work), ``<p>.follower_wait`` on non-leaders (their bcast entry
+        blocks until the leader finishes the exchange, so the wait
+        covers both phases and is named as such rather than
+        masquerading as bcast cost)."""
         if len(self._hosts) == 1:
             return local_result
-        out = leg(local_result) if self._is_leader() else None
-        return self._inner.bcast(out, root=0)
+        if not span_prefix:
+            out = leg(local_result) if self._is_leader() else None
+            return self._inner.bcast(out, root=0)
+        if self._is_leader():
+            with trace.span(f"{span_prefix}.leader_exchange"):
+                out = leg(local_result)
+            with trace.span(f"{span_prefix}.local_bcast"):
+                return self._inner.bcast(out, root=0)
+        with trace.span(f"{span_prefix}.follower_wait"):
+            return self._inner.bcast(None, root=0)
 
     # -- collectives -------------------------------------------------------
 
@@ -482,21 +499,16 @@ class _HybridGroupEngine:
             # fold it in the canonical tree instead (same order as every
             # other driver).
             return G.tree_combine(self.allgather(data), op)
-        # Inlined _leader_leg with a trace span per tier: the three
-        # phases hide behind one opaque latency otherwise, and a
-        # regression in the DCN-analogue leader tier would be
-        # indistinguishable from local noise (bench reads these spans;
-        # span() is a one-bool check when tracing is off).
+        # One trace span per tier (see _leader_leg): the phases hide
+        # behind one opaque latency otherwise, and a regression in the
+        # DCN-analogue leader tier would be indistinguishable from
+        # local noise (bench reads these spans; span() is a one-bool
+        # check when tracing is off).
         with trace.span("hybrid.allreduce.local_reduce"):
             local_total = self._inner.allreduce(data, op=op)
-        if len(self._hosts) == 1:
-            return local_total
-        out = None
-        if self._is_leader():
-            with trace.span("hybrid.allreduce.leader_exchange"):
-                out = G.allreduce(self._tcp_grp, local_total, op=op)
-        with trace.span("hybrid.allreduce.local_bcast"):
-            return self._inner.bcast(out, root=0)
+        return self._leader_leg(
+            local_total, lambda t: G.allreduce(self._tcp_grp, t, op=op),
+            span_prefix="hybrid.allreduce")
 
     def reduce(self, data: Any, root: int = 0, op: "OpLike" = "sum"
                ) -> Optional[Any]:

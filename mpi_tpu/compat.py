@@ -110,6 +110,10 @@ class Status:
 
 
 def _payload_count(obj: Any) -> int:
+    # NOTE: a None here counts as 1 like any other pickled object —
+    # "no message at all" (MESSAGE_NO_PROC) is decided by the CALLER
+    # from the message's source, never inferred from the payload, so a
+    # legitimately sent None is not conflated with a no-proc receive.
     if isinstance(obj, np.ndarray):
         return int(obj.size)
     if isinstance(obj, (bytes, bytearray, memoryview)):
@@ -185,14 +189,15 @@ class Request:
         return True, cls.Waitall(requests)
 
     @classmethod
-    def Testany(cls, requests: List["Request"]):
+    def testany(cls, requests: List["Request"]):
         """(index, flag, result): the first already-completed request
         (consumed: its slot becomes None); ``(MPI.UNDEFINED, True,
         None)`` when there are no active requests at all (MPI's
         no-active-handles case — flag TRUE, so drain loops terminate);
         ``(MPI.UNDEFINED, False, None)`` when active requests exist
-        but none is ready. mpi4py returns (index, flag); the payload
-        rides along here like the other set operations."""
+        but none is ready. The payload rides along because object-mode
+        receives have no user buffer for it to land in — the lowercase
+        twin of :meth:`Testany`, as ``waitall`` is to ``Waitall``."""
         if all(r is None for r in requests):
             return UNDEFINED, True, None
         for i, r in enumerate(requests):
@@ -202,7 +207,15 @@ class Request:
                 return i, True, result
         return UNDEFINED, False, None
 
-    testany = Testany
+    @classmethod
+    def Testany(cls, requests: List["Request"]):
+        """mpi4py's exact ``(index, flag)`` shape — drop-in code doing
+        ``idx, flag = Request.Testany(reqs)`` unpacks cleanly. A
+        completed request is consumed (slot becomes None) and buffer
+        ``Irecv``s run their fill; object-mode payloads are surfaced
+        by the lowercase :meth:`testany` instead."""
+        idx, flag, _ = cls.testany(requests)
+        return idx, flag
 
     @classmethod
     def Waitsome(cls, requests: List["Request"]):
@@ -235,16 +248,34 @@ class Message:
     def source(self) -> int:
         return self._m.source
 
+    def _is_no_proc(self) -> bool:
+        # The native no-proc message (PROC_NULL mprobe) carries
+        # source None — "no message at all" is decided from the
+        # SOURCE, never inferred from a None payload, so a
+        # legitimately sent None keeps its object count.
+        return self._m.source is None
+
     def recv(self, status: Optional[Status] = None) -> Any:
+        no_proc = self._is_no_proc()
         obj = self._m.recv()
         if status is not None:
-            status.source, status.tag = self._m.source, self._m.tag
-            status.count = _payload_count(obj)
+            status.source = PROC_NULL if no_proc else self._m.source
+            status.tag = self._m.tag
+            # mpi4py's MPI_MESSAGE_NO_PROC recv reports count 0.
+            status.count = 0 if no_proc else _payload_count(obj)
         return obj
 
     def Recv(self, buf: Any, status: Optional[Status] = None) -> None:
-        """Buffer form (MPI_Mrecv): the payload lands in ``buf``."""
+        """Buffer form (MPI_Mrecv): the payload lands in ``buf``.
+        The no-proc message completes immediately with ``buf``
+        untouched and count 0 (MPI_MESSAGE_NO_PROC contract)."""
         target = _RecvTarget(buf, "Message.Recv")
+        if self._is_no_proc():
+            self._m.recv()  # consume: the handle is single-use
+            if status is not None:
+                status.source, status.tag = PROC_NULL, self._m.tag
+                status.count = 0
+            return
         obj = self._m.recv()
         target.fill(obj)
         if status is not None:
@@ -521,8 +552,11 @@ class Comm:
         else:
             native = self._c.mprobe(source, tag)
         if status is not None:
-            status.source, status.tag = native.source, tag
-            status.count = _payload_count(native._payload)
+            no_proc = native.source is None
+            status.source = PROC_NULL if no_proc else native.source
+            status.tag = tag
+            status.count = (0 if no_proc
+                            else _payload_count(native._payload))
         return Message(native)
 
     def improbe(self, source: int = -1, tag: int = 0,
@@ -533,12 +567,20 @@ class Comm:
             if src is None:
                 return None
             source = src
-        native = self._c.improbe(source, tag)
+        if source == PROC_NULL:
+            # MPI_Improbe from PROC_NULL: flag true immediately with
+            # the no-proc message (same as the blocking Mprobe path).
+            native = self._c.mprobe(None, tag)
+        else:
+            native = self._c.improbe(source, tag)
         if native is None:
             return None
         if status is not None:
-            status.source, status.tag = native.source, tag
-            status.count = _payload_count(native._payload)
+            no_proc = native.source is None
+            status.source = PROC_NULL if no_proc else native.source
+            status.tag = tag
+            status.count = (0 if no_proc
+                            else _payload_count(native._payload))
         return Message(native)
 
     Mprobe = mprobe
@@ -2411,7 +2453,14 @@ class _MPI:
     def Get_version(self):
         """(major, minor) of the MPI standard surface this shim
         tracks: the MPI-3.1 feature set (nonblocking collectives,
-        RMA incl. passive target, neighborhood collectives)."""
+        RMA incl. passive target, neighborhood collectives). Some
+        MPI-4 facilities ARE additionally available — partitioned
+        point-to-point (``Psend_init``/``Precv_init``/``Prequest``)
+        and matched probes — but Sessions and Spawn-era dynamic
+        process management are not, so claiming (4, 0) would
+        overstate; version-gated callers wanting partitioned p2p
+        should feature-test ``hasattr(comm, "Psend_init")`` rather
+        than gate on this tuple."""
         return (3, 1)
 
     def Get_library_version(self) -> str:

@@ -1482,6 +1482,23 @@ class TestMatchedProbeCompat:
         res = run_spmd(main, n=3)
         assert res[0] == [1, 2]
 
+    def test_no_proc_message_count_zero(self):
+        """A PROC_NULL mprobe yields MESSAGE_NO_PROC; its recv carries
+        no payload, and Status.count must say 0 elements (mpi4py's
+        MPI_MESSAGE_NO_PROC contract), not a phantom 1."""
+        def main():
+            MPI, comm = _world()
+            st = MPI.Status()
+            m = comm.mprobe(source=MPI.PROC_NULL, tag=7)
+            got = m.recv(status=st)
+            out = (got, st.Get_count())
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        for got, cnt in res:
+            assert got is None and cnt == 0
+
 
 def _cb_errhandler(exc):
     raise exc
@@ -1494,10 +1511,14 @@ class TestRequestSetOps:
             r, n = comm.Get_rank(), comm.Get_size()
             # No active handles: MPI defines flag=True with
             # index=UNDEFINED (drain loops terminate on this).
-            idx, flag, _ = MPI.Request.Testany([])
+            # Uppercase = mpi4py's exact 2-tuple shape; the payload
+            # triple lives on the lowercase twin.
+            idx, flag = MPI.Request.Testany([])
             assert (idx, flag) == (MPI.UNDEFINED, True)
-            idx, flag, _ = MPI.Request.Testany([None, None])
+            idx, flag = MPI.Request.Testany([None, None])
             assert (idx, flag) == (MPI.UNDEFINED, True)
+            idx, flag, payload = MPI.Request.testany([None, None])
+            assert (idx, flag, payload) == (MPI.UNDEFINED, True, None)
             sends = [comm.isend(r * 100 + j, dest=j, tag=500 + r)
                      for j in range(n)]
             recvs = [comm.irecv(source=j, tag=500 + j)
