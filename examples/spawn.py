@@ -1,0 +1,79 @@
+"""spawn — dynamic process management (MPI_Comm_spawn demo).
+
+No reference analogue (btracey/mpi fixes the world at init,
+network.go:94-118); this demonstrates :mod:`mpi_tpu.spawn` through the
+mpi4py-compatible surface: a running world launches fresh worker
+processes at runtime, the workers' ``MPI.COMM_WORLD`` contains only
+the workers, and an intercommunicator bridges the two groups — the
+master/worker pattern mpi4py tutorials build with
+``MPI.COMM_SELF.Spawn``.
+
+The parent world scatters work to the spawned workers over the
+intercomm (rooted bcast), each worker computes its partial sum in its
+own world, and the parents gather the results back.
+
+Run::
+
+    python -m mpi_tpu.launch.mpirun 2 examples/spawn.py
+
+The launcher starts 2 parents; the parents spawn 3 workers themselves.
+When this file runs as a SPAWNED child (``Get_parent`` is non-null) it
+takes the worker role — one program, both sides, like the classic
+mpi4py spawn demo.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mpi_tpu.compat import MPI
+
+N_WORKERS = 3
+CHUNK = 1000
+
+
+def worker() -> None:
+    comm = MPI.COMM_WORLD
+    parent = MPI.Comm.Get_parent()
+    me, n = comm.Get_rank(), comm.Get_size()
+    lo = parent.bcast(None, root=0)       # rooted: from parent leader
+    # Each worker sums its slice of [lo, lo + n*CHUNK).
+    start = lo + me * CHUNK
+    part = sum(range(start, start + CHUNK))
+    parent.send(part, dest=0, tag=1)
+    print(f"worker {me}/{n}: sum[{start},{start + CHUNK}) = {part}",
+          flush=True)
+    parent.Disconnect()
+    MPI.Finalize()
+
+
+def parents() -> None:
+    comm = MPI.COMM_WORLD
+    me, n = comm.Get_rank(), comm.Get_size()
+    inter = comm.Spawn(os.path.abspath(__file__), maxprocs=N_WORKERS)
+    lo = 1
+    if me == 0:
+        inter.bcast(lo, root=MPI.ROOT)
+        total = sum(inter.recv(source=i, tag=1)
+                    for i in range(N_WORKERS))
+        want = sum(range(lo, lo + N_WORKERS * CHUNK))
+        assert total == want, (total, want)
+        print(f"parent 0/{n}: {N_WORKERS} spawned workers summed "
+              f"[{lo},{lo + N_WORKERS * CHUNK}) = {total} — OK",
+              flush=True)
+        for p in getattr(inter._c, "_spawned_procs", []):
+            p.wait(60)
+    else:
+        inter.bcast(None, root=MPI.PROC_NULL)
+        print(f"parent {me}/{n}: spawn + bridge joined — OK",
+              flush=True)
+    inter.Disconnect()   # free the intercomm + its bridge sockets
+    MPI.Finalize()
+
+
+if __name__ == "__main__":
+    if MPI.Comm.Get_parent() != MPI.COMM_NULL:
+        worker()
+    else:
+        parents()

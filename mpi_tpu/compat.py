@@ -1080,6 +1080,33 @@ class Comm:
         return Intercomm(create_intercomm(
             self._c, local_leader, peer_comm._c, remote_leader, tag=tag))
 
+    def Spawn(self, command: str, args: Any = None, maxprocs: int = 1,
+              info: Any = None, root: int = 0) -> "Intercomm":
+        """``MPI_Comm_spawn``: launch ``maxprocs`` copies of the
+        Python program ``command`` on this host and return the
+        intercommunicator to them (mpi4py shape: collective over this
+        comm; the children's ``MPI.COMM_WORLD`` contains exactly the
+        children, and their ``MPI.Comm.Get_parent()`` reaches back
+        here). ``info`` accepts mpi4py's argument slot and is ignored
+        (single-host spawn, one configuration). See
+        :mod:`mpi_tpu.spawn` for the bridge design."""
+        from . import spawn as _spawn
+
+        return Intercomm(_spawn.spawn(
+            self._c, command, tuple(args or ()), int(maxprocs),
+            root=root))
+
+    @staticmethod
+    def Get_parent() -> Any:
+        """``MPI_Comm_get_parent``: the intercomm to the spawning
+        processes, or ``COMM_NULL`` (``None``) when this process was
+        not spawned — gate with ``parent != MPI.COMM_NULL`` exactly as
+        with mpi4py."""
+        from . import spawn as _spawn
+
+        p = _spawn.get_parent()
+        return Intercomm(p) if p is not None else COMM_NULL
+
 
 class Cartcomm(Comm):
     """mpi4py ``MPI.Cartcomm`` over :class:`mpi_tpu.comm.CartComm`."""
@@ -1314,6 +1341,17 @@ class Intercomm:
         """Release the intercomm's private union communicator
         (``MPI_Comm_free`` analogue)."""
         self._c.free()
+
+    def Disconnect(self) -> None:
+        """``MPI_Comm_disconnect``: what mpi4py code calls on a
+        spawn/Get_parent intercomm when done with the other group —
+        frees the communicator and tears down the spawn bridge network
+        (sockets + reader threads; without this a long-running master
+        leaks one TCP mesh per ``Spawn``). On a non-spawn intercomm
+        this is :meth:`Free`."""
+        from . import spawn as _spawn
+
+        _spawn.disconnect(self._c)
 
     def allgather(self, sendobj: Any) -> List[Any]:
         return self._c.allgather(sendobj)
@@ -1760,6 +1798,11 @@ PROC_NULL = -3
 ROOT_SENTINEL = -4
 # MPI.UNDEFINED: Group rank queries for processes outside the group.
 UNDEFINED = -32766
+# MPI.COMM_NULL: what Get_parent returns in a non-spawned process.
+# None, so the mpi4py gate `parent != MPI.COMM_NULL` works: a real
+# Intercomm compares unequal to None, and a non-spawned process's
+# None compares equal.
+COMM_NULL = None
 
 # MPI_File amode bits (the ROMIO/MPICH values — mpi4py exposes the same
 # names; code combines them with |).
@@ -2316,6 +2359,7 @@ class _MPI:
     PROC_NULL = PROC_NULL
     ROOT = ROOT_SENTINEL
     UNDEFINED = UNDEFINED
+    COMM_NULL = COMM_NULL
     IN_PLACE = IN_PLACE
     ORDER_C = ORDER_C
     ORDER_F = ORDER_F
@@ -2402,6 +2446,7 @@ class _MPI:
         if not self.Is_initialized():
             api.init()
             self._self_tls.comm = None
+        self._connect_parent_if_spawned()
         cached = getattr(self._self_tls, "comm", None)
         if cached is None or cached._c._impl is not api.registered() \
                 or cached._c.members != (api.registered().rank(),):
@@ -2427,6 +2472,9 @@ class _MPI:
             api.init()
             with self._world_lock:
                 self._world_cache = None
+        # Outside the cache lock: the bridge join is collective (it
+        # waits for parents + sibling children).
+        self._connect_parent_if_spawned()
         with self._world_lock:
             if self._world_cache is None \
                     or self._world_cache._c._impl is not api.registered():
@@ -2436,6 +2484,19 @@ class _MPI:
     def Init(self) -> None:
         if not self.Is_initialized():
             api.init()
+        self._connect_parent_if_spawned()
+
+    @staticmethod
+    def _connect_parent_if_spawned() -> None:
+        """In a spawned child, MPI_Init is the moment the parents'
+        blocked ``spawn`` expects the child to connect (mpi4py
+        semantics) — join the bridge eagerly so a child that never
+        calls Get_parent doesn't strand its parents. Idempotent and
+        cached; a no-op for normal processes."""
+        from . import spawn as _spawn
+
+        if _spawn.is_spawned():
+            _spawn.get_parent()
 
     def Finalize(self) -> None:
         if self.Is_initialized():
@@ -2456,11 +2517,12 @@ class _MPI:
         RMA incl. passive target, neighborhood collectives). Some
         MPI-4 facilities ARE additionally available — partitioned
         point-to-point (``Psend_init``/``Precv_init``/``Prequest``)
-        and matched probes — but Sessions and Spawn-era dynamic
-        process management are not, so claiming (4, 0) would
-        overstate; version-gated callers wanting partitioned p2p
-        should feature-test ``hasattr(comm, "Psend_init")`` rather
-        than gate on this tuple."""
+        and matched probes — and ``Comm.Spawn``/``Get_parent``
+        dynamic process management works (:mod:`mpi_tpu.spawn`) —
+        but Sessions do not, so claiming (4, 0) would overstate;
+        version-gated callers should feature-test (e.g.
+        ``hasattr(comm, "Psend_init")``) rather than gate on this
+        tuple."""
         return (3, 1)
 
     def Get_library_version(self) -> str:

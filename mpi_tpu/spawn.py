@@ -1,0 +1,272 @@
+"""Dynamic process management — MPI_Comm_spawn / MPI_Comm_get_parent.
+
+No reference analogue: btracey/mpi fixes the world at init (rank =
+index in the sorted ``--mpi-alladdr`` list, network.go:94-118) and has
+no way to add processes. This module is mpi4py-parity work (the one
+commonly used dynamic-process facility), built entirely from existing
+subsystems — no core changes:
+
+* **Children run in their own private TCP world.** ``spawn`` launches
+  them through the standard flag ABI (``--mpi-addr``/``--mpi-alladdr``,
+  the launcher protocol of :mod:`mpi_tpu.launch.mpirun`), so a spawned
+  child's ``init()`` — and therefore its ``COMM_WORLD`` — contains
+  exactly the children, correct by construction.
+* **A second, private bridge network spans parents + children.** Each
+  parent and each child contributes one extra TCP endpoint; addresses
+  travel to the children in environment variables. Ranks on the bridge
+  follow the driver's sorted-address rule, so both sides derive the
+  same parent/child rank sets with no negotiation.
+* **The intercomm rides the existing machinery** over the bridge's
+  union world: ``create_group`` (collective among each side only,
+  disjoint tags) + :func:`mpi_tpu.intercomm.create_intercomm`
+  (leaders = group rank 0 of each side).
+
+Scope: local-host spawn (like the local launcher); children must reach
+:func:`get_parent` — directly, or via ``mpi_tpu.compat``'s ``MPI.Init``
+/ first ``COMM_WORLD`` access, which call it automatically for spawned
+processes — or the parents' ``spawn`` times out (the parents' bridge
+init blocks until every child connects).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+from .api import MpiError
+from .comm import Comm
+from .intercomm import Intercomm, create_intercomm
+
+__all__ = ["spawn", "get_parent", "is_spawned", "disconnect"]
+
+# Flag-protocol env overrides (flags.py ENV_*) that must NOT leak from
+# the parent's environment into a spawned child: the child's world is
+# fully specified by the argv spawn builds, and an inherited
+# MPI_TPU_PROTOCOL / MPI_TPU_ADDR / ... would reinterpret or override
+# it (e.g. TCP addresses read as unix-socket paths).
+_FLAG_ENV = ("MPI_TPU_ADDR", "MPI_TPU_ALLADDR", "MPI_TPU_INITTIMEOUT",
+             "MPI_TPU_PROTOCOL", "MPI_TPU_PASSWORD")
+
+ENV_BRIDGE_ADDR = "MPI_TPU_SPAWN_BRIDGE_ADDR"
+ENV_BRIDGE_ALL = "MPI_TPU_SPAWN_BRIDGE_ALL"
+ENV_PARENT_ADDRS = "MPI_TPU_SPAWN_PARENT_ADDRS"
+ENV_CHILD_ADDRS = "MPI_TPU_SPAWN_CHILD_ADDRS"
+ENV_PASSWORD_VAR = "MPI_TPU_SPAWN_PASSWORD"
+ENV_TIMEOUT = "MPI_TPU_SPAWN_TIMEOUT"
+_SPAWN_ENV = (ENV_BRIDGE_ADDR, ENV_BRIDGE_ALL, ENV_PARENT_ADDRS,
+              ENV_CHILD_ADDRS, ENV_PASSWORD_VAR, ENV_TIMEOUT)
+
+# create_group / create_intercomm bootstrap tags on the bridge's union
+# world (disjoint groups may share a tag, but distinct ones cost
+# nothing and read unambiguously).
+_TAG_PARENT_GROUP = 0
+_TAG_CHILD_GROUP = 1
+_TAG_INTERCOMM = 2
+
+
+def _alloc_addrs(n: int) -> List[str]:
+    """n free loopback endpoints (bind-and-release, the in-repo port
+    allocation idiom; zero-padded so string sort == numeric sort)."""
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    addrs = [f"127.0.0.1:{s.getsockname()[1]:05d}" for s in socks]
+    for s in socks:
+        s.close()
+    return addrs
+
+
+def _build_intercomm(bridge, bridge_all: List[str],
+                     parent_addrs: Sequence[str],
+                     child_addrs: Sequence[str],
+                     is_parent: bool) -> Intercomm:
+    """Both sides: union world over the bridge network -> own-side
+    group -> intercomm. ``bridge_all`` must be the sorted address list
+    (bridge rank = index, the driver's rule); ``parent_addrs`` /
+    ``child_addrs`` must be in LOGICAL order — parent comm rank and
+    child world rank respectively — so intercomm group rank i IS
+    logical rank i on both sides (ephemeral bridge ports sort
+    arbitrarily; deriving group order from the sorted addresses would
+    scramble which process is 'remote rank 0')."""
+    parent_ranks = tuple(bridge_all.index(a) for a in parent_addrs)
+    child_ranks = tuple(bridge_all.index(a) for a in child_addrs)
+    union = Comm(bridge, tuple(range(len(bridge_all))), 0)
+    if is_parent:
+        local = union.create_group(parent_ranks, tag=_TAG_PARENT_GROUP)
+        remote_leader = child_ranks[0]
+    else:
+        local = union.create_group(child_ranks, tag=_TAG_CHILD_GROUP)
+        remote_leader = parent_ranks[0]
+    return create_intercomm(local, 0, union, remote_leader,
+                            tag=_TAG_INTERCOMM)
+
+
+def spawn(comm: Comm, command: str, args: Sequence[str] = (),
+          maxprocs: int = 1, *, root: int = 0,
+          python: Optional[str] = None,
+          timeout: float = 60.0) -> Intercomm:
+    """Parent side (MPI_Comm_spawn): launch ``maxprocs`` copies of
+    ``python command *args`` on this host and return the
+    intercommunicator (local group = ``comm``'s members in bridge
+    order, remote group = the children). Collective over ``comm``.
+
+    The children see the standard flag ABI for their own world plus
+    the spawn environment for the bridge; the root's process handles
+    are attached to the returned intercomm as ``_spawned_procs`` so a
+    caller that wants to reap exit codes can. Blocks until every child
+    reaches :func:`get_parent` (compat's ``MPI.Init`` does so
+    automatically) or ``timeout`` expires."""
+    from .backends.tcp import TcpNetwork
+
+    if maxprocs < 1:
+        raise MpiError(f"mpi_tpu: spawn maxprocs must be >= 1, got "
+                       f"{maxprocs}")
+    me = comm.rank()
+    if me == root:
+        import secrets
+
+        nparents = comm.size()
+        # ONE allocation batch (all sockets held open together): three
+        # sequential bind-and-release batches could hand a freed port
+        # straight back and self-collide across the lists.
+        ports = _alloc_addrs(nparents + 2 * maxprocs)
+        parent_bridge = ports[:nparents]
+        child_world = ports[nparents:nparents + maxprocs]
+        child_bridge = ports[nparents + maxprocs:]
+        # Private handshake token for the bridge AND the child world:
+        # explicit on every endpoint, so neither inherits whatever
+        # --mpi-password the PARENT world was launched with (children
+        # don't know it) nor the ambient flag defaults.
+        password = secrets.token_hex(8)
+        payload = (parent_bridge, child_world, child_bridge, password)
+    else:
+        payload = None
+    parent_bridge, child_world, child_bridge, password = comm.bcast(
+        payload, root=root)
+    my_bridge_addr = parent_bridge[me]
+    bridge_all = sorted(parent_bridge + child_bridge)
+    # Child i's WORLD rank is its world addr's position in the sorted
+    # alladdr list (the driver's rule) — order the bridge addrs the
+    # same way so intercomm remote rank i is child world rank i.
+    order = sorted(range(maxprocs), key=lambda i: child_world[i])
+    child_bridge_ordered = [child_bridge[i] for i in order]
+
+    procs: List[subprocess.Popen] = []
+    if me == root:
+        # Child env: strip spawn vars inherited from OUR spawn (a
+        # nested spawn's grandchildren must not try to join the old
+        # bridge) and the flag-protocol env overrides (the child's
+        # world is fully specified by argv below); prepend the package
+        # root (launcher parity — the child program's cwd need not see
+        # mpi_tpu).
+        env = {k: v for k, v in os.environ.items()
+               if k not in _SPAWN_ENV and k not in _FLAG_ENV}
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        existing = env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
+                                 if existing else pkg_root)
+        env[ENV_BRIDGE_ALL] = ",".join(bridge_all)
+        env[ENV_PARENT_ADDRS] = ",".join(parent_bridge)
+        env[ENV_CHILD_ADDRS] = ",".join(child_bridge_ordered)
+        env[ENV_PASSWORD_VAR] = password
+        env[ENV_TIMEOUT] = f"{timeout:.1f}"
+        # mpi4py's canonical form is Spawn(sys.executable,
+        # args=[script]); the in-repo form is Spawn(script). Don't
+        # stack an interpreter on top of an interpreter.
+        if python is None and os.path.basename(command).startswith(
+                "python"):
+            base = [command, *args]
+        else:
+            base = [python or sys.executable, command, *args]
+        for waddr, baddr in zip(child_world, child_bridge):
+            argv = [*base,
+                    "--mpi-addr", waddr,
+                    "--mpi-alladdr", ",".join(sorted(child_world)),
+                    "--mpi-protocol", "tcp",
+                    "--mpi-password", password,
+                    "--mpi-inittimeout", f"{max(1, round(timeout))}s"]
+            procs.append(subprocess.Popen(
+                argv, env={**env, ENV_BRIDGE_ADDR: baddr}))
+
+    # Every parent joins the bridge; init blocks until the children
+    # connect (their get_parent side of this same all-to-all).
+    bridge = TcpNetwork(addr=my_bridge_addr, addrs=list(bridge_all),
+                        timeout=timeout, proto="tcp", password=password)
+    try:
+        bridge.init()
+    except Exception:
+        for p in procs:  # don't leave half-spawned children behind
+            p.kill()
+        raise
+    inter = _build_intercomm(bridge, bridge_all, parent_bridge,
+                             child_bridge_ordered, is_parent=True)
+    inter._spawned_procs = procs   # root: handles for reaping
+    inter._bridge_net = bridge     # Disconnect() tears this down
+    return inter
+
+
+_parent_lock = threading.Lock()
+_parent_cache: Optional[Intercomm] = None
+
+
+def is_spawned() -> bool:
+    """True when this process was launched by :func:`spawn`."""
+    return ENV_BRIDGE_ADDR in os.environ
+
+
+def get_parent() -> Optional[Intercomm]:
+    """Child side (MPI_Comm_get_parent): the intercommunicator to the
+    spawning group (local = this child world, remote = the parents),
+    or ``None`` when this process was not spawned. The first call
+    joins the bridge network — collective with the parents' ``spawn``
+    and the sibling children — then caches; later calls are free."""
+    global _parent_cache
+    if not is_spawned():
+        return None
+    with _parent_lock:
+        if _parent_cache is None:
+            from .backends.tcp import TcpNetwork
+
+            bridge_all = os.environ[ENV_BRIDGE_ALL].split(",")
+            bridge = TcpNetwork(
+                addr=os.environ[ENV_BRIDGE_ADDR],
+                addrs=list(bridge_all),
+                timeout=float(os.environ.get(ENV_TIMEOUT, "60")),
+                proto="tcp",
+                password=os.environ.get(ENV_PASSWORD_VAR))
+            bridge.init()
+            _parent_cache = _build_intercomm(
+                bridge, sorted(bridge_all),
+                os.environ[ENV_PARENT_ADDRS].split(","),
+                os.environ[ENV_CHILD_ADDRS].split(","),
+                is_parent=False)
+            _parent_cache._bridge_net = bridge
+    return _parent_cache
+
+
+def disconnect(inter: Intercomm) -> None:
+    """Tear down a spawn intercommunicator (MPI_Comm_disconnect):
+    free the communicator AND shut down its private bridge network —
+    sockets and reader threads that would otherwise accumulate one
+    mesh per spawn in a long-running master. After this the intercomm
+    is unusable; in a child, :func:`get_parent` thereafter returns
+    ``None`` (COMM_NULL — a disconnected child looks non-spawned, as
+    after mpi4py's ``Disconnect``) instead of rebuilding a bridge
+    whose far side is gone."""
+    global _parent_cache
+    net = getattr(inter, "_bridge_net", None)
+    inter.free()
+    if net is not None:
+        net.finalize()
+    with _parent_lock:
+        if _parent_cache is inter:
+            _parent_cache = None
+            os.environ.pop(ENV_BRIDGE_ADDR, None)  # is_spawned -> False
