@@ -31,7 +31,8 @@ point-to-point library over TCP, /root/reference) designed TPU-first:
 """
 
 from .comm import CartComm, Comm, cart_create, comm_self, comm_world
-from .distgraph import DistGraphComm, dist_graph_create_adjacent
+from .distgraph import (DistGraphComm, GraphComm,
+                        dist_graph_create_adjacent, graph_create)
 from .intercomm import Intercomm, create_intercomm
 from .io import File, open_file
 from .window import Window, win_create
@@ -165,6 +166,8 @@ __all__ = [
     "create_intercomm",
     "DistGraphComm",
     "dist_graph_create_adjacent",
+    "GraphComm",
+    "graph_create",
     "File",
     "open_file",
     "__version__",

@@ -58,7 +58,9 @@ take the ``[buf, counts, displs, datatype]`` spec.
 
 Scope honesty: this is the commonly-used core surface, not all of
 mpi4py (no ``Create_struct`` across mixed dtypes — one base dtype per
-datatype; no dynamic process management; passive-target RMA
+datatype; dynamic process management covers ``Comm.Spawn`` /
+``Get_parent`` / ``Disconnect`` but not ``Open_port``-style
+accept/connect or MPI Sessions; passive-target RMA
 (``Win.Lock``/``Unlock``/``Flush``) needs the window created with
 ``info={"locks": "true"}`` — see :meth:`Win.Create`; window
 displacements are element offsets into the exposed array, so
@@ -1043,6 +1045,20 @@ class Comm:
         return Distgraphcomm(dist_graph_create_adjacent(
             self._c, list(sources), list(destinations)))
 
+    def Create_graph(self, index, edges, reorder: bool = False
+                     ) -> "Graphcomm":
+        """Legacy general-graph topology (``MPI_Graph_create``):
+        every rank passes the same global ``index``/``edges`` arrays
+        in the MPI-1 cumulative convention. ``reorder`` is accepted
+        and ignored (rank order is preserved). The graph must be
+        symmetric for the neighbor collectives, and ``len(index)``
+        must equal the comm size — see
+        :func:`mpi_tpu.distgraph.graph_create`."""
+        from .distgraph import graph_create
+
+        return Graphcomm(graph_create(self._c, list(index),
+                                      list(edges)))
+
     def Get_group(self) -> "Group":
         """This comm's group (``MPI_Comm_group``): all ranks, comm
         order."""
@@ -1260,6 +1276,59 @@ class Distgraphcomm(Comm):
         """``sendobj[i]`` travels out-edge ``i``; returns one payload
         per in-edge (MPI_Neighbor_alltoall)."""
         return self._c.neighbor_alltoall(sendobj)
+
+
+class Graphcomm(Distgraphcomm):
+    """mpi4py ``MPI.Graphcomm`` over
+    :class:`mpi_tpu.distgraph.GraphComm` — the legacy MPI-1 general
+    graph: the whole ``(index, edges)`` adjacency is global knowledge,
+    so any rank can query any node; neighbor collectives are inherited
+    from the distributed-graph engine."""
+
+    def Get_dims(self):
+        """(nnodes, nedges) — MPI_Graphdims_get."""
+        return self._c.graph_dims()
+
+    dims = property(Get_dims)
+
+    def Get_topo(self):
+        """(index, edges) as passed to ``Create_graph``
+        (MPI_Graph_get)."""
+        return list(self._c.index), list(self._c.edges)
+
+    topo = property(Get_topo)
+
+    @property
+    def index(self) -> List[int]:
+        return list(self._c.index)
+
+    @property
+    def edges(self) -> List[int]:
+        return list(self._c.edges)
+
+    @property
+    def nnodes(self) -> int:
+        return self._c.graph_dims()[0]
+
+    @property
+    def nedges(self) -> int:
+        return self._c.graph_dims()[1]
+
+    def Get_neighbors_count(self, rank: int) -> int:
+        """MPI_Graph_neighbors_count."""
+        return self._c.graph_neighbors_count(rank)
+
+    def Get_neighbors(self, rank: int) -> List[int]:
+        """MPI_Graph_neighbors."""
+        return list(self._c.graph_neighbors(rank))
+
+    @property
+    def nneighbors(self) -> int:
+        return self.Get_neighbors_count(self.Get_rank())
+
+    @property
+    def neighbors(self) -> List[int]:
+        return self.Get_neighbors(self.Get_rank())
 
 
 class Intercomm:
@@ -2426,6 +2495,7 @@ class _MPI:
     Group = Group
     Cartcomm = Cartcomm
     Distgraphcomm = Distgraphcomm
+    Graphcomm = Graphcomm
     Intercomm = Intercomm
     Win = Win
     File = File

@@ -31,7 +31,8 @@ from typing import Any, List, Optional, Sequence, Tuple
 from .api import MpiError, Request
 from .comm import CTX_SPAN, USER_TAG_SPAN, _NEIGHBOR_SLICE, Comm
 
-__all__ = ["DistGraphComm", "dist_graph_create_adjacent"]
+__all__ = ["DistGraphComm", "dist_graph_create_adjacent",
+           "GraphComm", "graph_create"]
 
 _MAX_DUP_EDGES = 64
 
@@ -188,3 +189,97 @@ class DistGraphComm(Comm):
         payload per in-edge (MPI_Neighbor_allgather)."""
         return self.neighbor_alltoall(
             [data] * len(self._destinations), tag=tag)
+
+
+def graph_create(comm: Comm, index: Sequence[int],
+                 edges: Sequence[int],
+                 validate: bool = True) -> "GraphComm":
+    """Legacy general-graph topology (MPI_Graph_create).
+
+    Every rank passes the SAME global adjacency: ``index[i]`` is the
+    cumulative neighbor count through node ``i`` and ``edges`` the
+    flattened adjacency lists (the MPI-1 convention mpi4py's
+    ``Create_graph`` takes verbatim), so node ``i``'s neighbors are
+    ``edges[index[i-1]:index[i]]``. ``len(index)`` must equal the comm
+    size (MPI permits fewer nodes, returning COMM_NULL on the excess
+    ranks; this rebuild keeps worlds fully populated — pass a
+    sub-communicator instead, a documented deviation).
+
+    Neighborhood collectives on a legacy graph require a SYMMETRIC
+    graph (MPI-3 §7.6 inherits this from MPI-1); construction verifies
+    it through the same edge-count handshake the distributed-graph
+    constructor runs, raising on every rank rather than deadlocking a
+    later collective. Collective over ``comm``; ``reorder`` has no
+    analogue (ranks never renumber here)."""
+    n = comm.size()
+    index = list(index)
+    # mpi4py's Create_graph also accepts the standard nnodes+1 form
+    # with a leading 0 (index[0] == 0, counts shifted one right) —
+    # strip it so portable adjacency arrays work verbatim.
+    if len(index) == n + 1 and index and index[0] == 0:
+        index = index[1:]
+    local_err: Optional[str] = None
+    if len(index) != n:
+        local_err = (f"len(index)={len(index)} != comm size {n} "
+                     f"(partial graphs: use a sub-communicator)")
+    elif list(index) != sorted(index) or (index and index[0] < 0):
+        local_err = f"index must be non-decreasing cumulative counts"
+    elif index and len(edges) != index[-1]:
+        local_err = (f"len(edges)={len(edges)} != index[-1]="
+                     f"{index[-1]}")
+    if local_err is not None:
+        # Unlike the adjacent constructor, the arguments are GLOBAL —
+        # every rank holds the same lists and derives the same verdict
+        # locally, so raising before any collective cannot strand a
+        # peer mid-bootstrap.
+        raise MpiError(f"mpi_tpu: bad graph: {local_err}")
+    me = comm.rank()
+    lo = index[me - 1] if me > 0 else 0
+    mine = tuple(int(e) for e in edges[lo:index[me]])
+    base = dist_graph_create_adjacent(comm, mine, mine,
+                                      validate=validate)
+    return GraphComm(base, tuple(int(i) for i in index),
+                     tuple(int(e) for e in edges))
+
+
+class GraphComm(DistGraphComm):
+    """A legacy-graph communicator: a :class:`DistGraphComm` whose
+    adjacency came from the global ``(index, edges)`` arrays, plus the
+    MPI-1 query surface (MPI_Graphdims_get / MPI_Graph_get /
+    MPI_Graph_neighbors[_count]) — any rank can ask about any node,
+    because the whole graph is global knowledge."""
+
+    def __init__(self, base: DistGraphComm, index: Tuple[int, ...],
+                 edges: Tuple[int, ...]):
+        # Adopt the already-bootstrapped context and adjacency.
+        super().__init__(base, base._sources, base._destinations)
+        self._index = index
+        self._edges = edges
+
+    @property
+    def index(self) -> Tuple[int, ...]:
+        return self._index
+
+    @property
+    def edges(self) -> Tuple[int, ...]:
+        return self._edges
+
+    def graph_dims(self) -> Tuple[int, int]:
+        """(nnodes, nedges) — MPI_Graphdims_get."""
+        return len(self._index), len(self._edges)
+
+    def graph_neighbors(self, rank: int) -> Tuple[int, ...]:
+        """Node ``rank``'s neighbor list — MPI_Graph_neighbors."""
+        if not 0 <= rank < len(self._index):
+            raise MpiError(f"mpi_tpu: graph rank {rank} out of range "
+                           f"[0, {len(self._index)})")
+        lo = self._index[rank - 1] if rank > 0 else 0
+        return self._edges[lo:self._index[rank]]
+
+    def graph_neighbors_count(self, rank: int) -> int:
+        """MPI_Graph_neighbors_count."""
+        return len(self.graph_neighbors(rank))
+
+    def __repr__(self) -> str:
+        return (f"GraphComm(ctx={self._ctx}, nodes={len(self._index)}, "
+                f"edges={len(self._edges)})")

@@ -536,6 +536,87 @@ class TestDistgraphcomm:
             assert a2a == [{"payload": (r - 1) % 3}]
 
 
+class TestGraphcomm:
+    def test_legacy_graph_queries_and_collectives(self):
+        # 4-node graph, mpi4py tutorial arrays: a path 0-1-2-3 plus
+        # the 1-3 chord; symmetric, so neighbor collectives work.
+        #   0: [1]  1: [0, 2, 3]  2: [1, 3]  3: [1, 2]
+        index = [1, 4, 6, 8]
+        edges = [1, 0, 2, 3, 1, 3, 1, 2]
+
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            g = comm.Create_graph(index, edges)
+            assert isinstance(g, MPI.Graphcomm)
+            out = dict(
+                dims=g.Get_dims(),
+                topo=g.Get_topo(),
+                mine=g.neighbors,
+                nmine=g.nneighbors,
+                # Global knowledge: every rank can query any node.
+                of2=g.Get_neighbors(2),
+                cnt3=g.Get_neighbors_count(3),
+                ag=sorted(g.neighbor_allgather(r * 10)),
+            )
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=4)
+        want_nbrs = {0: [1], 1: [0, 2, 3], 2: [1, 3], 3: [1, 2]}
+        for r, out in enumerate(res):
+            assert out["dims"] == (4, 8)
+            assert out["topo"] == (index, edges)
+            assert out["mine"] == want_nbrs[r]
+            assert out["nmine"] == len(want_nbrs[r])
+            assert out["of2"] == [1, 3] and out["cnt3"] == 2
+            assert out["ag"] == sorted(v * 10 for v in want_nbrs[r])
+
+    def test_nnodes_plus_one_index_form_accepted(self):
+        """mpi4py also accepts the standard nnodes+1 index arrays with
+        a leading 0 — portable adjacency code must work verbatim."""
+        def main():
+            MPI, comm = _world()
+            g = comm.Create_graph([0, 1, 2], [1, 0])  # 2-node path
+            out = (g.Get_dims(), g.neighbors)
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[0] == ((2, 2), [1]) and res[1] == ((2, 2), [0])
+
+    def test_asymmetric_graph_rejected_everywhere(self):
+        def main():
+            MPI, comm = _world()
+            # 0->1 declared, but node 1 lists no neighbor: asymmetric.
+            try:
+                comm.Create_graph([1, 1], [1])
+            except MPI.Exception:
+                out = True
+            except Exception as exc:  # native MpiError acceptable too
+                out = "inconsistent" in str(exc)
+            else:
+                out = False
+            MPI.Finalize()
+            return out
+
+        assert run_spmd(main, n=2) == [True, True]
+
+    def test_bad_index_raises(self):
+        def main():
+            MPI, comm = _world()
+            try:
+                comm.Create_graph([2, 1], [0, 1])  # not cumulative
+            except Exception as exc:
+                out = "non-decreasing" in str(exc)
+            else:
+                out = False
+            MPI.Finalize()
+            return out
+
+        assert run_spmd(main, n=2) == [True, True]
+
+
 class TestIntercomm:
     def _make(self, MPI, comm):
         """Split world into even/odd groups bridged by COMM_WORLD."""
