@@ -59,8 +59,9 @@ take the ``[buf, counts, displs, datatype]`` spec.
 Scope honesty: this is the commonly-used core surface, not all of
 mpi4py (no ``Create_struct`` across mixed dtypes — one base dtype per
 datatype; dynamic process management covers ``Comm.Spawn`` /
-``Get_parent`` / ``Disconnect`` but not ``Open_port``-style
-accept/connect or MPI Sessions; passive-target RMA
+``Get_parent`` / ``Disconnect`` and ``Open_port`` /
+``Comm.Accept`` / ``Comm.Connect``, but not MPI Sessions;
+passive-target RMA
 (``Win.Lock``/``Unlock``/``Flush``) needs the window created with
 ``info={"locks": "true"}`` — see :meth:`Win.Create`; window
 displacements are element offsets into the exposed array, so
@@ -1122,6 +1123,26 @@ class Comm:
 
         p = _spawn.get_parent()
         return Intercomm(p) if p is not None else COMM_NULL
+
+    def Accept(self, port_name: str, info: Any = None, root: int = 0
+               ) -> "Intercomm":
+        """``MPI_Comm_accept``: block until a client group
+        ``Connect``\\ s to ``port_name`` (from :func:`Open_port`),
+        then return the intercomm to it. Collective over this comm;
+        ``info`` accepted and ignored."""
+        from . import spawn as _spawn
+
+        return Intercomm(_spawn.accept(self._c, port_name, root=root))
+
+    def Connect(self, port_name: str, info: Any = None, root: int = 0
+                ) -> "Intercomm":
+        """``MPI_Comm_connect``: rendezvous with the server group
+        accepting on ``port_name``; returns the intercomm. Collective
+        over this comm; ``info`` accepted and ignored. Retries the
+        dial until the server reaches ``Accept`` (or times out)."""
+        from . import spawn as _spawn
+
+        return Intercomm(_spawn.connect(self._c, port_name, root=root))
 
 
 class Cartcomm(Comm):
@@ -2580,6 +2601,23 @@ class _MPI:
         import socket
 
         return socket.gethostname()
+
+    @staticmethod
+    def Open_port(info: Any = None) -> str:
+        """``MPI_Open_port``: a rendezvous address for
+        ``Comm.Accept``/``Comm.Connect`` (advertise it out of band,
+        as with mpi4py). ``info`` accepted and ignored."""
+        from . import spawn as _spawn
+
+        return _spawn.open_port()
+
+    @staticmethod
+    def Close_port(port_name: str) -> None:
+        """``MPI_Close_port`` (surface parity; see
+        :func:`mpi_tpu.spawn.close_port`)."""
+        from . import spawn as _spawn
+
+        _spawn.close_port(port_name)
 
     def Get_version(self):
         """(major, minor) of the MPI standard surface this shim

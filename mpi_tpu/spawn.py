@@ -41,7 +41,8 @@ from .api import MpiError
 from .comm import Comm
 from .intercomm import Intercomm, create_intercomm
 
-__all__ = ["spawn", "get_parent", "is_spawned", "disconnect"]
+__all__ = ["spawn", "get_parent", "is_spawned", "disconnect",
+           "open_port", "close_port", "accept", "connect"]
 
 # Flag-protocol env overrides (flags.py ENV_*) that must NOT leak from
 # the parent's environment into a spawned child: the child's world is
@@ -270,3 +271,201 @@ def disconnect(inter: Intercomm) -> None:
         if _parent_cache is inter:
             _parent_cache = None
             os.environ.pop(ENV_BRIDGE_ADDR, None)  # is_spawned -> False
+
+
+# --------------------------------------------------------------------------
+# Client/server connection (MPI_Open_port / MPI_Comm_accept /
+# MPI_Comm_connect): two INDEPENDENT, already-running worlds join
+# through a rendezvous address instead of a parent launching children.
+# The handshake socket carries one JSON line each way (group sizes +
+# bridge addresses + a fresh token); the intercomm then rides the same
+# private-bridge construction spawn uses.
+# --------------------------------------------------------------------------
+
+def open_port() -> str:
+    """MPI_Open_port: a rendezvous address ("host:port") a server
+    passes to :func:`accept` and advertises to clients out of band
+    (a file, a nameserver, argv). The address is allocated now but
+    only listened on inside ``accept`` — clients retry their dial
+    until the server is there (or their timeout expires)."""
+    return _alloc_addrs(1)[0]
+
+
+def close_port(port_name: str) -> None:
+    """MPI_Close_port: nothing is held between calls here — the
+    listener lives only inside :func:`accept` — so this is a no-op
+    kept for surface parity."""
+
+
+def _recv_json_line(sock: socket.socket, limit: int = 1 << 20) -> dict:
+    import json as _json
+
+    buf = bytearray()
+    while not buf.endswith(b"\n"):
+        if len(buf) > limit:
+            raise MpiError("mpi_tpu: accept/connect handshake line "
+                           "too long")
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise MpiError("mpi_tpu: accept/connect handshake closed "
+                           "early")
+        buf += chunk
+    return _json.loads(buf.decode())
+
+
+def _send_json_line(sock: socket.socket, obj: dict) -> None:
+    import json as _json
+
+    sock.sendall((_json.dumps(obj) + "\n").encode())
+
+
+def _bcast_or_raise(comm: Comm, payload, err: Optional[str], root: int):
+    """Root's handshake outcome travels to every rank — success
+    payload or error string — so a failed rendezvous raises the SAME
+    error on the whole collective instead of stranding non-root ranks
+    in a bcast no one will ever feed."""
+    payload, err = comm.bcast((payload, err), root=root)
+    if err is not None:
+        raise MpiError(err)
+    return payload
+
+
+def accept(comm: Comm, port_name: str, *, root: int = 0,
+           timeout: float = 60.0) -> Intercomm:
+    """Server side (MPI_Comm_accept): block until one client group
+    :func:`connect`\\ s to ``port_name``, then return the
+    intercommunicator (local = this comm's members, remote = the
+    client's). Collective over ``comm``; a failed rendezvous raises on
+    every rank. A malformed peer (stale dialer from an earlier
+    timed-out connect, port-reuse traffic) is dropped and the listener
+    keeps waiting for a real client until the deadline."""
+    import time as _time
+
+    me = comm.rank()
+    payload, err = None, None
+    if me == root:
+        import secrets
+
+        n = comm.size()
+        server_bridge = _alloc_addrs(n)
+        password = secrets.token_hex(8)
+        host, _, port = port_name.rpartition(":")
+        deadline = _time.monotonic() + timeout
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        client_bridge: Optional[List[str]] = None
+        try:
+            srv.bind((host or "127.0.0.1", int(port)))
+            srv.listen(4)
+            while client_bridge is None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    err = (f"mpi_tpu: accept on {port_name}: no client "
+                           f"connected within {timeout:.0f}s")
+                    break
+                srv.settimeout(remaining)
+                try:
+                    conn, _addr = srv.accept()
+                except socket.timeout:
+                    continue
+                try:
+                    conn.settimeout(max(0.1,
+                                        deadline - _time.monotonic()))
+                    hello = _recv_json_line(conn)
+                    bridge = list(hello["bridge"])
+                    _send_json_line(conn, {"bridge": server_bridge,
+                                           "password": password})
+                    client_bridge = bridge
+                except Exception:  # noqa: BLE001 - one bad peer
+                    continue       # keep listening for a real client
+                finally:
+                    conn.close()
+        except OSError as exc:
+            err = f"mpi_tpu: accept on {port_name}: {exc}"
+        finally:
+            srv.close()
+        if err is None and client_bridge is not None:
+            dup = set(server_bridge) & set(client_bridge)
+            if dup:
+                # Independent bind-and-release batches in two
+                # processes CAN collide (the self-collision spawn's
+                # single batch prevents); a clear error beats an
+                # EADDRINUSE mesh hang on 2n processes.
+                err = (f"mpi_tpu: accept/connect bridge port "
+                       f"collision {sorted(dup)}; retry the "
+                       f"rendezvous")
+            else:
+                payload = (server_bridge, client_bridge, password)
+        elif err is None:
+            err = f"mpi_tpu: accept on {port_name}: no client"
+    server_bridge, client_bridge, password = _bcast_or_raise(
+        comm, payload, err, root)
+    return _join_bridge(comm, server_bridge, client_bridge, password,
+                        accepting=True, timeout=timeout)
+
+
+def connect(comm: Comm, port_name: str, *, root: int = 0,
+            timeout: float = 60.0) -> Intercomm:
+    """Client side (MPI_Comm_connect): rendezvous with the server
+    group accepting on ``port_name``; returns the intercomm
+    (local = this comm's members, remote = the server's). Collective
+    over ``comm``. The dial retries until the server reaches
+    ``accept`` or ``timeout`` expires."""
+    import time as _time
+
+    me = comm.rank()
+    n = comm.size()
+    payload, err = None, None
+    if me == root:
+        client_bridge = _alloc_addrs(n)
+        host, _, port = port_name.rpartition(":")
+        deadline = _time.monotonic() + timeout
+        conn: Optional[socket.socket] = None
+        while conn is None:
+            try:
+                conn = socket.create_connection(
+                    (host or "127.0.0.1", int(port)),
+                    timeout=max(0.1, deadline - _time.monotonic()))
+            except OSError:
+                if _time.monotonic() >= deadline:
+                    err = (f"mpi_tpu: connect to {port_name}: no "
+                           f"server accepted within {timeout:.0f}s")
+                    break
+                _time.sleep(0.1)  # server not in accept() yet; retry
+        if err is None:
+            try:
+                conn.settimeout(max(0.1,
+                                    deadline - _time.monotonic()))
+                _send_json_line(conn, {"bridge": client_bridge})
+                reply = _recv_json_line(conn)
+                payload = (list(reply["bridge"]), client_bridge,
+                           str(reply["password"]))
+            except Exception as exc:  # noqa: BLE001 - whole-comm raise
+                err = (f"mpi_tpu: connect to {port_name}: handshake "
+                       f"failed: {exc}")
+            finally:
+                conn.close()
+    server_bridge, client_bridge, password = _bcast_or_raise(
+        comm, payload, err, root)
+    return _join_bridge(comm, server_bridge, client_bridge, password,
+                        accepting=False, timeout=timeout)
+
+
+def _join_bridge(comm: Comm, server_bridge: List[str],
+                 client_bridge: List[str], password: str,
+                 accepting: bool, timeout: float) -> Intercomm:
+    """Shared tail of accept/connect: every member joins the bridge
+    network on its side's addr (indexed by ITS comm rank — both lists
+    are in comm-rank order, so intercomm group rank i is comm rank i
+    on both sides, exactly like spawn) and builds the intercomm."""
+    from .backends.tcp import TcpNetwork
+
+    my_addr = (server_bridge if accepting else client_bridge)[comm.rank()]
+    bridge_all = sorted(server_bridge + client_bridge)
+    bridge = TcpNetwork(addr=my_addr, addrs=list(bridge_all),
+                        timeout=timeout, proto="tcp", password=password)
+    bridge.init()
+    inter = _build_intercomm(bridge, bridge_all, server_bridge,
+                             client_bridge, is_parent=accepting)
+    inter._bridge_net = bridge     # disconnect() tears this down
+    return inter

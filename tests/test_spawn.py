@@ -121,3 +121,116 @@ class TestSpawn:
             return out
 
         assert run_spmd(main, n=1) == [True]
+
+
+CLIENT = textwrap.dedent("""\
+    import sys
+    from mpi_tpu.compat import MPI
+
+    port = sys.argv[1]
+    comm = MPI.COMM_WORLD          # the client-only world
+    inter = comm.Connect(port)
+    assert inter.Get_remote_size() == 2
+    inter.send(("cli", comm.Get_rank(), comm.Get_size()), dest=0, tag=2)
+    inter.Disconnect()
+    MPI.Finalize()
+""")
+
+
+class TestAcceptConnect:
+    def test_accept_connect_two_worlds(self, tmp_path):
+        """Two INDEPENDENT worlds (server in-process, client a real
+        2-process TCP world) rendezvous through Open_port/Accept/
+        Connect; intercomm group rank i == comm rank i on both
+        sides."""
+        prog = tmp_path / "client.py"
+        prog.write_text(CLIENT)
+
+        def main():
+            import os
+            import subprocess
+
+            from mpi_tpu import spawn as _spawn
+            from mpi_tpu.compat import MPI
+
+            comm = MPI.COMM_WORLD
+            r = comm.Get_rank()
+            procs = []
+            if r == 0:
+                port = MPI.Open_port()
+                addrs = _spawn._alloc_addrs(2)
+                alladdr = ",".join(sorted(addrs))
+                # The client program lives in tmp_path: put the repo
+                # on its import path (spawn() does this for its own
+                # children; here WE are the launcher).
+                repo = os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))
+                env = {**os.environ,
+                       "PYTHONPATH": repo + os.pathsep
+                       + os.environ.get("PYTHONPATH", "")}
+                procs = [subprocess.Popen(
+                    [sys.executable, str(prog), port,
+                     "--mpi-addr", a, "--mpi-alladdr", alladdr,
+                     "--mpi-protocol", "tcp",
+                     "--mpi-inittimeout", "60s"], env=env)
+                    for a in addrs]
+            else:
+                port = None
+            port = comm.bcast(port, root=0)
+            inter = comm.Accept(port)
+            assert inter.Get_remote_size() == 2
+            if r == 0:
+                # remote rank i IS client world rank i
+                msgs = [inter.recv(source=i, tag=2) for i in range(2)]
+                for p in procs:
+                    assert p.wait(60) == 0
+                MPI.Close_port(port)
+            else:
+                msgs = None
+            inter.Disconnect()
+            MPI.Finalize()
+            return msgs
+
+        res = run_spmd(main, n=2)
+        assert res[0] == [("cli", 0, 2), ("cli", 1, 2)]
+
+    def test_connect_times_out_without_server(self):
+        def main():
+            from mpi_tpu import spawn as _spawn
+            from mpi_tpu.comm import comm_world
+
+            import mpi_tpu
+            mpi_tpu.init()
+            port = _spawn.open_port()   # nobody ever accepts
+            try:
+                _spawn.connect(comm_world(), port, timeout=2.0)
+            except api.MpiError as exc:
+                out = "no server accepted" in str(exc)
+            else:
+                out = False
+            mpi_tpu.finalize()
+            return out
+
+        assert run_spmd(main, n=1) == [True]
+
+    def test_accept_timeout_raises_on_all_ranks(self):
+        """A failed rendezvous must fail the COLLECTIVE: non-root
+        ranks get the root's error through the outcome bcast instead
+        of hanging in a bcast nobody feeds."""
+        def main():
+            import mpi_tpu
+            from mpi_tpu import spawn as _spawn
+            from mpi_tpu.comm import comm_world
+
+            mpi_tpu.init()
+            port = _spawn.open_port()   # nobody ever connects
+            try:
+                _spawn.accept(comm_world(), port, timeout=2.0)
+            except api.MpiError as exc:
+                out = "no client connected" in str(exc)
+            else:
+                out = False
+            mpi_tpu.finalize()
+            return out
+
+        assert run_spmd(main, n=2) == [True, True]
