@@ -60,8 +60,10 @@ Scope honesty: this is the commonly-used core surface, not all of
 mpi4py (no ``Create_struct`` across mixed dtypes — one base dtype per
 datatype; dynamic process management covers ``Comm.Spawn`` /
 ``Get_parent`` / ``Disconnect`` and ``Open_port`` /
-``Comm.Accept`` / ``Comm.Connect``, but not MPI Sessions;
-passive-target RMA
+``Comm.Accept`` / ``Comm.Connect``; the MPI-4 Sessions surface
+(``MPI.Session.Init`` → psets → ``Group.Create_from_session_pset``
+→ ``Comm.Create_from_group``) works, backed by the driver world —
+see :class:`Session` for the honesty note; passive-target RMA
 (``Win.Lock``/``Unlock``/``Flush``) needs the window created with
 ``info={"locks": "true"}`` — see :meth:`Win.Create`; window
 displacements are element offsets into the exposed array, so
@@ -1086,6 +1088,25 @@ class Comm:
             return None
         return Comm(self._c.create_group(group._ranks, tag=tag))
 
+    @classmethod
+    def Create_from_group(cls, group: "Group", stringtag: str = "",
+                          info: Any = None, errhandler: Any = None
+                          ) -> "Comm":
+        """MPI-4 Sessions: a communicator directly from a group
+        (``MPI_Comm_create_from_group``) — collective among the
+        group's members ONLY, no parent communicator named at the call
+        site. ``stringtag`` disambiguates concurrent constructions
+        exactly as in MPI; it maps onto the bounded bootstrap tag
+        space by a stable hash, so distinct concurrent stringtags on
+        overlapping groups collide with probability 1/4096 — use
+        distinct literal tags there, as MPI itself requires.
+        ``info``/``errhandler`` accepted and ignored."""
+        import zlib
+
+        tag = zlib.crc32(str(stringtag).encode()) % 4096
+        return Comm(group._parent._c.create_group(group._ranks,
+                                                  tag=tag))
+
     def Create_intercomm(self, local_leader: int, peer_comm: "Comm",
                          remote_leader: int, tag: int = 0
                          ) -> "Intercomm":
@@ -1124,25 +1145,32 @@ class Comm:
         p = _spawn.get_parent()
         return Intercomm(p) if p is not None else COMM_NULL
 
-    def Accept(self, port_name: str, info: Any = None, root: int = 0
-               ) -> "Intercomm":
+    def Accept(self, port_name: str, info: Any = None, root: int = 0,
+               timeout: Optional[float] = None) -> "Intercomm":
         """``MPI_Comm_accept``: block until a client group
         ``Connect``\\ s to ``port_name`` (from :func:`Open_port`),
         then return the intercomm to it. Collective over this comm;
-        ``info`` accepted and ignored."""
+        ``info`` accepted and ignored. Blocks indefinitely by default
+        — MPI's own semantics (a server routinely starts long before
+        its clients); the extra ``timeout`` kwarg bounds the wait for
+        callers that want one (mpi4py code never passes it)."""
         from . import spawn as _spawn
 
-        return Intercomm(_spawn.accept(self._c, port_name, root=root))
+        return Intercomm(_spawn.accept(self._c, port_name, root=root,
+                                       timeout=timeout))
 
-    def Connect(self, port_name: str, info: Any = None, root: int = 0
-                ) -> "Intercomm":
+    def Connect(self, port_name: str, info: Any = None, root: int = 0,
+                timeout: Optional[float] = None) -> "Intercomm":
         """``MPI_Comm_connect``: rendezvous with the server group
         accepting on ``port_name``; returns the intercomm. Collective
         over this comm; ``info`` accepted and ignored. Retries the
-        dial until the server reaches ``Accept`` (or times out)."""
+        dial until the server reaches ``Accept`` — indefinitely by
+        default, like MPI; bound it with the extra ``timeout``
+        kwarg."""
         from . import spawn as _spawn
 
-        return Intercomm(_spawn.connect(self._c, port_name, root=root))
+        return Intercomm(_spawn.connect(self._c, port_name, root=root,
+                                        timeout=timeout))
 
 
 class Cartcomm(Comm):
@@ -1232,6 +1260,14 @@ class Group:
                 f"mpi_tpu.compat: group rank {r} out of range "
                 f"[0, {len(self._ranks)})")
         return r
+
+    @classmethod
+    def Create_from_session_pset(cls, session: "Session",
+                                 pset_name: str) -> "Group":
+        """MPI-4 Sessions: the group of a named process set
+        (``MPI_Group_from_session_pset``). Feed the result to
+        :meth:`Comm.Create_from_group`."""
+        return session._pset_group(pset_name)
 
     def Incl(self, ranks) -> "Group":
         """Subset containing ``ranks`` (group ranks), in that order."""
@@ -1799,6 +1835,86 @@ class File:
 
     def __exit__(self, *exc: Any) -> None:
         self.Close()
+
+
+class Session:
+    """MPI-4 Sessions (``MPI.Session.Init`` → psets → groups →
+    communicators → ``Finalize``): the world-free initialization model
+    mpi4py 4.x exposes.
+
+    Scope honesty: the Sessions MODEL promises initialization with no
+    global state; this rebuild backs every session with the driver's
+    world transport (one refcounted ``init`` under the hood, same as
+    ``MPI.Init``) while preserving the session-LOCAL API — multiple
+    concurrent sessions, pset introspection, and communicator
+    construction from a pset group without ever touching
+    ``COMM_WORLD`` — so sessions-model mpi4py code runs verbatim. The
+    two built-in process sets are ``mpi://WORLD`` and ``mpi://SELF``
+    (names case-insensitive in the scheme/authority part, per MPI)."""
+
+    _PSETS = ("mpi://WORLD", "mpi://SELF")
+
+    def __init__(self):
+        api.init()
+        self._finalized = False
+
+    @classmethod
+    def Init(cls, info: Any = None, errhandler: Any = None
+             ) -> "Session":
+        """``MPI_Session_init``; ``info``/``errhandler`` accepted and
+        ignored (one transport configuration)."""
+        return cls()
+
+    def _check_live(self) -> None:
+        if self._finalized:
+            raise api.MpiError(
+                "mpi_tpu.compat: operation on a finalized Session")
+
+    def Get_num_psets(self, info: Any = None) -> int:
+        self._check_live()
+        return len(self._PSETS)
+
+    def Get_nth_pset(self, n: int, info: Any = None) -> str:
+        self._check_live()
+        if not 0 <= n < len(self._PSETS):
+            raise api.MpiError(
+                f"mpi_tpu.compat: pset index {n} out of range "
+                f"[0, {len(self._PSETS)})")
+        return self._PSETS[n]
+
+    def _pset_ranks(self, pset_name: str) -> tuple:
+        self._check_live()
+        name = str(pset_name).lower()
+        if name == "mpi://world":
+            return tuple(range(api.size()))
+        if name == "mpi://self":
+            return (api.rank(),)
+        raise api.MpiError(
+            f"mpi_tpu.compat: unknown process set {pset_name!r} "
+            f"(have {', '.join(self._PSETS)})")
+
+    def Get_pset_info(self, pset_name: str) -> "Info":
+        """``MPI_Session_get_pset_info``: at minimum ``mpi_size``,
+        per the standard."""
+        info = Info()
+        info.Set("mpi_size", str(len(self._pset_ranks(pset_name))))
+        return info
+
+    def _pset_group(self, pset_name: str) -> "Group":
+        """Backs ``Group.Create_from_session_pset``."""
+        ranks = self._pset_ranks(pset_name)
+        return Group(MPI.COMM_WORLD, ranks)
+
+    def Finalize(self) -> None:
+        """``MPI_Session_finalize`` (refcounted with any other
+        sessions / ``MPI.Init`` holders of the transport)."""
+        if not self._finalized:
+            self._finalized = True
+            api.finalize()
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        state = "finalized" if self._finalized else "live"
+        return f"Session({state})"
 
 
 class Info(dict):
@@ -2518,6 +2634,7 @@ class _MPI:
     Distgraphcomm = Distgraphcomm
     Graphcomm = Graphcomm
     Intercomm = Intercomm
+    Session = Session
     Win = Win
     File = File
 
@@ -2621,17 +2738,19 @@ class _MPI:
 
     def Get_version(self):
         """(major, minor) of the MPI standard surface this shim
-        tracks: the MPI-3.1 feature set (nonblocking collectives,
-        RMA incl. passive target, neighborhood collectives). Some
-        MPI-4 facilities ARE additionally available — partitioned
-        point-to-point (``Psend_init``/``Precv_init``/``Prequest``)
-        and matched probes — and ``Comm.Spawn``/``Get_parent``
-        dynamic process management works (:mod:`mpi_tpu.spawn`) —
-        but Sessions do not, so claiming (4, 0) would overstate;
-        version-gated callers should feature-test (e.g.
-        ``hasattr(comm, "Psend_init")``) rather than gate on this
-        tuple."""
-        return (3, 1)
+        tracks. (4, 0): on top of the full MPI-3.1 core (nonblocking
+        collectives, RMA incl. passive target and PSCW, neighborhood
+        collectives, matched probes), the headline MPI-4 facilities
+        all work — partitioned point-to-point
+        (``Psend_init``/``Precv_init``/``Prequest``), persistent
+        collectives (``allreduce_init`` et al.), Sessions
+        (:class:`Session`), and dynamic process management
+        (``Comm.Spawn``/``Get_parent``, ``Open_port``/``Accept``/
+        ``Connect``; :mod:`mpi_tpu.spawn`). As with any
+        implementation, feature-test specific calls (e.g.
+        ``hasattr(comm, "Psend_init")``) rather than gating broad
+        behavior on this tuple."""
+        return (4, 0)
 
     def Get_library_version(self) -> str:
         import mpi_tpu

@@ -330,74 +330,106 @@ def _bcast_or_raise(comm: Comm, payload, err: Optional[str], root: int):
     return payload
 
 
+def _handshake_timeout(deadline: Optional[float],
+                       cap: float = 60.0) -> float:
+    """Per-socket-op timeout: bounded by the caller's deadline when
+    one exists, by ``cap`` when blocking indefinitely (a dead peer
+    mid-handshake must not wedge an unbounded accept forever)."""
+    import time as _time
+
+    if deadline is None:
+        return cap
+    return max(0.1, min(cap, deadline - _time.monotonic()))
+
+
 def accept(comm: Comm, port_name: str, *, root: int = 0,
-           timeout: float = 60.0) -> Intercomm:
+           timeout: Optional[float] = 60.0) -> Intercomm:
     """Server side (MPI_Comm_accept): block until one client group
     :func:`connect`\\ s to ``port_name``, then return the
     intercommunicator (local = this comm's members, remote = the
-    client's). Collective over ``comm``; a failed rendezvous raises on
-    every rank. A malformed peer (stale dialer from an earlier
-    timed-out connect, port-reuse traffic) is dropped and the listener
-    keeps waiting for a real client until the deadline."""
+    client's). Collective over ``comm``; ANY root-side failure —
+    timeout, malformed port name, bind error — raises the same
+    MpiError on every rank (the outcome travels in a bcast; a raise
+    that skipped it would strand the non-roots). ``timeout=None``
+    blocks indefinitely, MPI's own semantics (the compat ``Accept``
+    default). A malformed peer (stale dialer from an earlier
+    timed-out connect, port-reuse traffic) is dropped and the
+    listener keeps waiting for a real client."""
     import time as _time
 
     me = comm.rank()
     payload, err = None, None
     if me == root:
-        import secrets
-
-        n = comm.size()
-        server_bridge = _alloc_addrs(n)
-        password = secrets.token_hex(8)
-        host, _, port = port_name.rpartition(":")
-        deadline = _time.monotonic() + timeout
-        srv = socket.socket()
-        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        client_bridge: Optional[List[str]] = None
         try:
-            srv.bind((host or "127.0.0.1", int(port)))
-            srv.listen(4)
-            while client_bridge is None:
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    err = (f"mpi_tpu: accept on {port_name}: no client "
-                           f"connected within {timeout:.0f}s")
-                    break
-                srv.settimeout(remaining)
-                try:
-                    conn, _addr = srv.accept()
-                except socket.timeout:
-                    continue
-                try:
-                    conn.settimeout(max(0.1,
-                                        deadline - _time.monotonic()))
-                    hello = _recv_json_line(conn)
-                    bridge = list(hello["bridge"])
-                    _send_json_line(conn, {"bridge": server_bridge,
-                                           "password": password})
-                    client_bridge = bridge
-                except Exception:  # noqa: BLE001 - one bad peer
-                    continue       # keep listening for a real client
-                finally:
-                    conn.close()
-        except OSError as exc:
-            err = f"mpi_tpu: accept on {port_name}: {exc}"
-        finally:
-            srv.close()
-        if err is None and client_bridge is not None:
-            dup = set(server_bridge) & set(client_bridge)
-            if dup:
-                # Independent bind-and-release batches in two
-                # processes CAN collide (the self-collision spawn's
-                # single batch prevents); a clear error beats an
-                # EADDRINUSE mesh hang on 2n processes.
-                err = (f"mpi_tpu: accept/connect bridge port "
-                       f"collision {sorted(dup)}; retry the "
-                       f"rendezvous")
-            else:
-                payload = (server_bridge, client_bridge, password)
-        elif err is None:
-            err = f"mpi_tpu: accept on {port_name}: no client"
+            import secrets
+
+            n = comm.size()
+            server_bridge = _alloc_addrs(n)
+            password = secrets.token_hex(8)
+            host, _, port = port_name.rpartition(":")
+            port_num = int(port)   # malformed port_name raises here
+            deadline = (None if timeout is None
+                        else _time.monotonic() + timeout)
+            srv = socket.socket()
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            client_bridge: Optional[List[str]] = None
+            try:
+                srv.bind((host or "127.0.0.1", port_num))
+                srv.listen(4)
+                while client_bridge is None and err is None:
+                    if deadline is None:
+                        srv.settimeout(None)
+                    else:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            err = (f"mpi_tpu: accept on {port_name}: "
+                                   f"no client connected within "
+                                   f"{timeout:.0f}s")
+                            break
+                        srv.settimeout(remaining)
+                    try:
+                        conn, _addr = srv.accept()
+                    except socket.timeout:
+                        continue
+                    try:
+                        conn.settimeout(_handshake_timeout(deadline))
+                        hello = _recv_json_line(conn)
+                        bridge = list(hello["bridge"])
+                        dup = set(server_bridge) & set(bridge)
+                        if dup:
+                            # Independent bind-and-release batches in
+                            # two processes CAN collide (spawn's
+                            # single batch prevents the SELF-collision
+                            # only). Checked BEFORE the success reply,
+                            # and the client is told too — otherwise
+                            # it would burn its timeout in a doomed
+                            # bridge init while the server reports
+                            # the actionable message.
+                            msg = (f"mpi_tpu: accept/connect bridge "
+                                   f"port collision {sorted(dup)}; "
+                                   f"retry the rendezvous")
+                            _send_json_line(conn, {"error": msg})
+                            err = msg
+                        else:
+                            _send_json_line(
+                                conn, {"bridge": server_bridge,
+                                       "password": password})
+                            client_bridge = bridge
+                    except Exception:  # noqa: BLE001 - one bad peer
+                        continue       # keep listening for a client
+                    finally:
+                        conn.close()
+            finally:
+                srv.close()
+            if err is None:
+                if client_bridge is not None:
+                    payload = (server_bridge, client_bridge, password)
+                else:
+                    err = f"mpi_tpu: accept on {port_name}: no client"
+        except Exception as exc:  # noqa: BLE001 - whole-comm raise
+            if err is None:
+                err = (f"mpi_tpu: accept on {port_name}: "
+                       f"{type(exc).__name__}: {exc}")
     server_bridge, client_bridge, password = _bcast_or_raise(
         comm, payload, err, root)
     return _join_bridge(comm, server_bridge, client_bridge, password,
@@ -405,46 +437,64 @@ def accept(comm: Comm, port_name: str, *, root: int = 0,
 
 
 def connect(comm: Comm, port_name: str, *, root: int = 0,
-            timeout: float = 60.0) -> Intercomm:
+            timeout: Optional[float] = 60.0) -> Intercomm:
     """Client side (MPI_Comm_connect): rendezvous with the server
     group accepting on ``port_name``; returns the intercomm
     (local = this comm's members, remote = the server's). Collective
-    over ``comm``. The dial retries until the server reaches
-    ``accept`` or ``timeout`` expires."""
+    over ``comm``; any root-side failure raises on every rank (same
+    outcome-bcast as :func:`accept`). The dial retries until the
+    server reaches ``accept``; ``timeout=None`` retries
+    indefinitely."""
     import time as _time
 
     me = comm.rank()
     n = comm.size()
     payload, err = None, None
     if me == root:
-        client_bridge = _alloc_addrs(n)
-        host, _, port = port_name.rpartition(":")
-        deadline = _time.monotonic() + timeout
-        conn: Optional[socket.socket] = None
-        while conn is None:
-            try:
-                conn = socket.create_connection(
-                    (host or "127.0.0.1", int(port)),
-                    timeout=max(0.1, deadline - _time.monotonic()))
-            except OSError:
-                if _time.monotonic() >= deadline:
-                    err = (f"mpi_tpu: connect to {port_name}: no "
-                           f"server accepted within {timeout:.0f}s")
-                    break
-                _time.sleep(0.1)  # server not in accept() yet; retry
-        if err is None:
-            try:
-                conn.settimeout(max(0.1,
-                                    deadline - _time.monotonic()))
-                _send_json_line(conn, {"bridge": client_bridge})
-                reply = _recv_json_line(conn)
-                payload = (list(reply["bridge"]), client_bridge,
-                           str(reply["password"]))
-            except Exception as exc:  # noqa: BLE001 - whole-comm raise
-                err = (f"mpi_tpu: connect to {port_name}: handshake "
-                       f"failed: {exc}")
-            finally:
-                conn.close()
+        try:
+            client_bridge = _alloc_addrs(n)
+            host, _, port = port_name.rpartition(":")
+            port_num = int(port)   # malformed port_name raises here
+            deadline = (None if timeout is None
+                        else _time.monotonic() + timeout)
+            conn: Optional[socket.socket] = None
+            while conn is None and err is None:
+                try:
+                    conn = socket.create_connection(
+                        (host or "127.0.0.1", port_num),
+                        timeout=_handshake_timeout(deadline, cap=10.0))
+                except OSError:
+                    if deadline is not None \
+                            and _time.monotonic() >= deadline:
+                        err = (f"mpi_tpu: connect to {port_name}: no "
+                               f"server accepted within "
+                               f"{timeout:.0f}s")
+                        break
+                    _time.sleep(0.1)  # server not in accept(); retry
+            if err is None:
+                try:
+                    conn.settimeout(_handshake_timeout(deadline))
+                    _send_json_line(conn, {"bridge": client_bridge})
+                    reply = _recv_json_line(conn)
+                    if "error" in reply:
+                        # The server detected a problem (e.g. a bridge
+                        # port collision) and told us the actionable
+                        # message instead of letting us burn the
+                        # timeout in a doomed bridge init.
+                        err = str(reply["error"])
+                    else:
+                        payload = (list(reply["bridge"]),
+                                   client_bridge,
+                                   str(reply["password"]))
+                except Exception as exc:  # noqa: BLE001
+                    err = (f"mpi_tpu: connect to {port_name}: "
+                           f"handshake failed: {exc}")
+                finally:
+                    conn.close()
+        except Exception as exc:  # noqa: BLE001 - whole-comm raise
+            if err is None:
+                err = (f"mpi_tpu: connect to {port_name}: "
+                       f"{type(exc).__name__}: {exc}")
     server_bridge, client_bridge, password = _bcast_or_raise(
         comm, payload, err, root)
     return _join_bridge(comm, server_bridge, client_bridge, password,
@@ -453,17 +503,22 @@ def connect(comm: Comm, port_name: str, *, root: int = 0,
 
 def _join_bridge(comm: Comm, server_bridge: List[str],
                  client_bridge: List[str], password: str,
-                 accepting: bool, timeout: float) -> Intercomm:
+                 accepting: bool,
+                 timeout: Optional[float]) -> Intercomm:
     """Shared tail of accept/connect: every member joins the bridge
     network on its side's addr (indexed by ITS comm rank — both lists
     are in comm-rank order, so intercomm group rank i is comm rank i
-    on both sides, exactly like spawn) and builds the intercomm."""
+    on both sides, exactly like spawn) and builds the intercomm. An
+    unbounded rendezvous still gets a BOUNDED bridge init: once the
+    handshake succeeded both sides are live, so a peer that dies now
+    should fail the init, not hang it forever."""
     from .backends.tcp import TcpNetwork
 
     my_addr = (server_bridge if accepting else client_bridge)[comm.rank()]
     bridge_all = sorted(server_bridge + client_bridge)
     bridge = TcpNetwork(addr=my_addr, addrs=list(bridge_all),
-                        timeout=timeout, proto="tcp", password=password)
+                        timeout=120.0 if timeout is None else timeout,
+                        proto="tcp", password=password)
     bridge.init()
     inter = _build_intercomm(bridge, bridge_all, server_bridge,
                              client_bridge, is_parent=accepting)

@@ -1318,7 +1318,10 @@ class TestCommSelfAttrsVersion:
         for got, gone, renamed, ver, lib_ok in res:
             assert got == {"x": 1} and gone is None
             assert renamed == "my world"
-            assert ver == (3, 1) and lib_ok
+            # (4, 0) as of the round-4 surface: Sessions, partitioned
+            # p2p, persistent collectives, and dynamic process
+            # management are all present (see Get_version docstring).
+            assert ver == (4, 0) and lib_ok
 
     def test_attrs_and_names_are_per_rank(self):
         """Under thread-per-rank drivers every rank shares ONE native
@@ -1697,3 +1700,67 @@ class TestPartitionedCompat:
 
         res = run_spmd(main, n=2)
         assert res[0] is True and res[1] == [0.0, 1.0, 2.0, 3.0]
+
+
+class TestSessions:
+    """MPI-4 Sessions model: init without touching COMM_WORLD, pset
+    introspection, group -> communicator construction, finalize."""
+
+    def test_session_pset_to_comm_roundtrip(self):
+        def main():
+            from mpi_tpu.compat import MPI
+
+            session = MPI.Session.Init()
+            try:
+                n = session.Get_num_psets()
+                names = [session.Get_nth_pset(i) for i in range(n)]
+                assert "mpi://WORLD" in names and "mpi://SELF" in names
+                wsize = int(session.Get_pset_info("mpi://WORLD")
+                            .Get("mpi_size"))
+                group = MPI.Group.Create_from_session_pset(
+                    session, "mpi://WORLD")
+                comm = MPI.Comm.Create_from_group(group, "r4-test")
+                total = comm.allreduce(comm.Get_rank())
+                self_group = MPI.Group.Create_from_session_pset(
+                    session, "mpi://SELF")
+                self_comm = MPI.Comm.Create_from_group(self_group,
+                                                       "r4-self")
+                out = (wsize, comm.Get_size(), total,
+                       self_comm.Get_size())
+            finally:
+                session.Finalize()
+            return out
+
+        res = run_spmd(main, n=3)
+        assert res == [(3, 3, 3, 1)] * 3
+
+    def test_session_case_insensitive_and_errors(self):
+        def main():
+            from mpi_tpu.compat import MPI
+
+            s = MPI.Session.Init()
+            assert s.Get_pset_info("MPI://world").Get("mpi_size") == "2"
+            try:
+                s.Get_nth_pset(99)
+            except MPI.Exception:
+                ok_range = True
+            except api.MpiError:
+                ok_range = True
+            else:
+                ok_range = False
+            try:
+                s._pset_ranks("mpi://nonsense")
+            except api.MpiError as exc:
+                ok_name = "unknown process set" in str(exc)
+            else:
+                ok_name = False
+            s.Finalize()
+            try:
+                s.Get_num_psets()
+            except api.MpiError as exc:
+                ok_fin = "finalized Session" in str(exc)
+            else:
+                ok_fin = False
+            return ok_range and ok_name and ok_fin
+
+        assert run_spmd(main, n=2) == [True, True]

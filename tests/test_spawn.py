@@ -234,3 +234,24 @@ class TestAcceptConnect:
             return out
 
         assert run_spmd(main, n=2) == [True, True]
+
+    def test_malformed_port_raises_on_all_ranks(self):
+        """A root-side failure OUTSIDE the socket path (int() on a
+        malformed port name) must also reach every rank through the
+        outcome bcast, never strand non-roots."""
+        def main():
+            import mpi_tpu
+            from mpi_tpu import spawn as _spawn
+            from mpi_tpu.comm import comm_world
+
+            mpi_tpu.init()
+            try:
+                _spawn.accept(comm_world(), "localhost", timeout=5.0)
+            except api.MpiError as exc:
+                out = "ValueError" in str(exc)
+            else:
+                out = False
+            mpi_tpu.finalize()
+            return out
+
+        assert run_spmd(main, n=2) == [True, True]
