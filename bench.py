@@ -650,6 +650,14 @@ def _hybrid_allreduce_child() -> int:
     hosts, local = 4, 8
     size_bytes = 1 << 20
     reps, warmup = 12, 3
+    # A/B the chunk-pipelined leader leg (ships gate-closed; see
+    # backends/hybrid.py): same engine, same ranks, pipeline forced on
+    # via the env threshold vs the default serial leg. times_by[label]
+    # collects rank-0 per-op wall clocks per variant.
+    variants = [("1MiB", 1 << 20, None),
+                ("8MiB_pipelined", 8 << 20, str(4 << 20)),
+                ("8MiB_serial", 8 << 20, None)]
+    times_by: dict = {label: [] for label, _, _ in variants}
 
     socks = []
     for _ in range(hosts):
@@ -660,23 +668,41 @@ def _hybrid_allreduce_child() -> int:
     for s in socks:
         s.close()
 
-    elems = size_bytes // 4
-    times: list = []
+    tier_evs: list = []   # spans from the 1 MiB variant ONLY
 
     def fn_for(net):
         def main():
             net.init()
-            x = np.full(elems, float(net.rank()), np.float32)
-            for i in range(warmup + reps):
-                t0 = time.perf_counter()
-                r = net.allreduce(x)
-                dt = time.perf_counter() - t0
+            for vi, (label, size, pipeline_min) in enumerate(variants):
+                # Env toggle is process-global: fence it with barriers
+                # so every rank of every variant sees one setting.
+                net.barrier()
                 if net.rank() == 0:
-                    if i >= warmup:
-                        times.append(dt)
-                    if i == 0 and not np.allclose(
-                            np.asarray(r)[:4], 31 * 32 / 2):
-                        raise RuntimeError("hybrid allreduce wrong sum")
+                    if vi == 1:
+                        # The per-tier keys are labelled 1MiB: snapshot
+                        # before the 8 MiB variants pollute the buffer.
+                        tier_evs.extend(trace.events())
+                        trace.clear()
+                    if pipeline_min is None:
+                        os.environ.pop("MPI_TPU_HYBRID_PIPELINE_MIN",
+                                       None)
+                    else:
+                        os.environ["MPI_TPU_HYBRID_PIPELINE_MIN"] = \
+                            pipeline_min
+                net.barrier()
+                n_reps = reps if size <= (1 << 20) else 6
+                x = np.full(size // 4, float(net.rank()), np.float32)
+                for i in range(warmup + n_reps):
+                    t0 = time.perf_counter()
+                    r = net.allreduce(x)
+                    dt = time.perf_counter() - t0
+                    if net.rank() == 0:
+                        if i >= warmup:
+                            times_by[label].append(dt)
+                        if i == 0 and not np.allclose(
+                                np.asarray(r)[:4], 31 * 32 / 2):
+                            raise RuntimeError(
+                                f"hybrid allreduce wrong sum ({label})")
             net.finalize()
         return main
 
@@ -707,19 +733,31 @@ def _hybrid_allreduce_child() -> int:
         # against a wedged engine — fail explicitly instead.
         raise RuntimeError(
             "hybrid allreduce: host thread(s) still running after 300s")
-    p50 = statistics.median(times)
+    p50 = statistics.median(times_by["1MiB"])
     rec = {
         "hybrid_allreduce_1MiB_p50_us_4x8": round(p50 * 1e6, 1),
         "hybrid_allreduce_1MiB_gbps_4x8": round(size_bytes / p50 / 1e9, 3),
         "hybrid_allreduce_world": hosts * local,
     }
-    # Per-tier medians over every recorded span (all ranks record
-    # local_reduce; only the 4 leaders record leader_exchange and
-    # local_bcast — a non-leader's bcast entry blocks on its leader's
-    # exchange, so its wait is recorded separately as follower_wait
-    # instead of polluting the bcast cost. Warmup iterations included —
-    # the median is robust to their compile/connect cost).
-    evs = trace.events()
+    # The pipelined leader leg vs forced serial at 8 MiB (same engine,
+    # same run): the delta is the overlap of the exchange and bcast
+    # tiers (backends/hybrid.py _pipelined_leader_leg).
+    p_pipe = statistics.median(times_by["8MiB_pipelined"])
+    p_ser = statistics.median(times_by["8MiB_serial"])
+    rec["hybrid_allreduce_8MiB_pipelined_p50_us_4x8"] = round(
+        p_pipe * 1e6, 1)
+    rec["hybrid_allreduce_8MiB_serial_p50_us_4x8"] = round(
+        p_ser * 1e6, 1)
+    rec["hybrid_allreduce_8MiB_pipeline_speedup"] = round(
+        p_ser / p_pipe, 2)
+    # Per-tier medians over the 1 MiB variant's spans (all ranks
+    # record local_reduce; only the 4 leaders record leader_exchange
+    # and local_bcast — a non-leader's bcast entry blocks on its
+    # leader's exchange, so its wait is recorded separately as
+    # follower_wait instead of polluting the bcast cost. Warmup
+    # iterations included — the median is robust to their
+    # compile/connect cost).
+    evs = tier_evs
     for tier in ("local_reduce", "leader_exchange", "local_bcast",
                  "follower_wait"):
         durs = sorted(e["dur_us"] for e in evs

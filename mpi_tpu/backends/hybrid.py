@@ -489,6 +489,86 @@ class _HybridGroupEngine:
 
     # -- collectives -------------------------------------------------------
 
+    # Large allreduces CAN pipeline the two leader-leg tiers — the
+    # 1 MiB x 32-rank tier split shows exchange (~14 ms) and bcast
+    # (~7 ms leader-side; followers wait out both, ~21 ms) fully
+    # serialized on the critical path, and on a real
+    # multi-host fabric they use different resources (NIC vs local
+    # memory), so overlap should approach max() of the tiers. On the
+    # one-core loopback box the A/B is contention noise (0.45x-1.25x
+    # across runs, bench keys hybrid_allreduce_8MiB_*), so the gate
+    # ships CLOSED — same discipline as quantized_eligible: the
+    # default path must never lose at any measured size on the
+    # measured fabric. Enable on a real deployment with
+    # MPI_TPU_HYBRID_PIPELINE_MIN=<bytes> (4 MiB is the design point)
+    # after its own A/B.
+    _PIPELINE_CHUNKS = 4
+
+    @staticmethod
+    def _pipeline_min_bytes() -> int:
+        import os as _os
+
+        try:
+            return int(_os.environ.get("MPI_TPU_HYBRID_PIPELINE_MIN",
+                                       str(1 << 62)))
+        except ValueError:
+            return 1 << 62
+
+    def _pipelined_leader_leg(self, total, op) -> Any:
+        """Chunked overlap of the leader leg's two serial tiers: the
+        leader runs the per-chunk TCP exchange in a producer thread
+        while the main thread broadcasts each exchanged chunk locally
+        — chunk i's exchange rides UNDER chunk i-1's bcast, so the
+        critical path approaches max(exchange, bcast) + one chunk
+        instead of their sum. Deterministic chunking (np.array_split
+        on the flat buffer) keeps every rank's bcast sequence
+        identical; the producer is the only _tcp_grp user while it
+        runs, so the leader tier's collective ordering is unchanged."""
+        import numpy as np
+
+        with trace.span("hybrid.allreduce.pipelined",
+                        nbytes=int(total.nbytes)):
+            shape, dtype = total.shape, total.dtype
+            chunks = np.array_split(total.reshape(-1),
+                                    self._PIPELINE_CHUNKS)
+            if self._is_leader():
+                import queue
+
+                done: "queue.Queue" = queue.Queue()
+
+                def producer() -> None:
+                    try:
+                        for ch in chunks:
+                            done.put(G.allreduce(self._tcp_grp,
+                                                 np.ascontiguousarray(ch),
+                                                 op=op))
+                    except BaseException as exc:  # noqa: BLE001
+                        done.put(exc)  # surfaced by the consumer below
+
+                th = threading.Thread(target=producer, daemon=True,
+                                      name="hybrid-pipeline-exchange")
+                th.start()
+                out = []
+                for _ in chunks:
+                    item = done.get()
+                    if isinstance(item, BaseException):
+                        # Every local rank still gets its bcast (the
+                        # exception travels), so the failure raises on
+                        # the whole host instead of deadlocking it.
+                        self._inner.bcast(item, root=0)
+                        th.join()
+                        raise item
+                    out.append(self._inner.bcast(item, root=0))
+                th.join()
+            else:
+                out = []
+                for _ in chunks:
+                    item = self._inner.bcast(None, root=0)
+                    if isinstance(item, BaseException):
+                        raise item
+                    out.append(item)
+            return np.concatenate(out).astype(dtype).reshape(shape)
+
     def allreduce(self, data: Any, op="sum") -> Any:
         G.check_op(op)
         if callable(op):
@@ -506,6 +586,12 @@ class _HybridGroupEngine:
         # check when tracing is off).
         with trace.span("hybrid.allreduce.local_reduce"):
             local_total = self._inner.allreduce(data, op=op)
+        import numpy as np
+
+        if len(self._hosts) > 1 \
+                and isinstance(local_total, np.ndarray) \
+                and local_total.nbytes >= self._pipeline_min_bytes():
+            return self._pipelined_leader_leg(local_total, op)
         return self._leader_leg(
             local_total, lambda t: G.allreduce(self._tcp_grp, t, op=op),
             span_prefix="hybrid.allreduce")

@@ -245,3 +245,60 @@ def test_collective_file_io_32_ranks(tmp_path):
     assert starts == [sum(r % 3 + 1 for r in range(k))
                       for k in range(WORLD)]
     assert all(w == want for _, w in got)
+
+
+def test_pipelined_large_allreduce_bitwise_matches_serial(monkeypatch):
+    """The chunk-pipelined leader leg (engaged above the size
+    threshold) must produce the same bytes as the serial leg: same
+    per-chunk TCP tree order, same dtype — only the schedule differs.
+    The threshold is dropped so a test-sized payload pipelines; a
+    trace span proves the pipelined path actually engaged (without
+    that, a dead gate would compare serial vs serial and pass
+    vacuously)."""
+    from mpi_tpu.utils import trace
+
+    # Cleanup on ANY exit path: a failing rank thread must not leak
+    # the threshold into later hybrid tests in this process.
+    monkeypatch.setenv("MPI_TPU_HYBRID_PIPELINE_MIN", "1024")
+    trace.enable()
+    results: dict = {}
+    lock = threading.Lock()
+
+    def fn_for(net):
+        def main():
+            net.init()
+            r = net.rank()
+            x = np.arange(4096, dtype=np.float32) * 0.5 + r
+            import os
+            # Barrier-fenced env toggle (process-global): every rank
+            # must be past its pipelined call before anyone pops, or a
+            # late rank would read the serial setting and the leaders
+            # would disagree on the protocol. monkeypatch restores the
+            # var afterwards regardless of how this thread exits.
+            net.barrier()
+            piped = net.allreduce(x)
+            net.barrier()
+            if r == 0:
+                os.environ["MPI_TPU_HYBRID_PIPELINE_MIN"] = str(1 << 62)
+            net.barrier()
+            serial = net.allreduce(x)
+            with lock:
+                results[r] = (np.asarray(piped), np.asarray(serial))
+            net.finalize()
+        return main
+
+    try:
+        run_world(fn_for)
+        evs = [e for e in trace.events()
+               if e["name"] == "hybrid.allreduce.pipelined"]
+    finally:
+        trace.disable()
+        trace.clear()
+    assert len(results) == WORLD
+    # Engagement proof: every rank's first allreduce went pipelined.
+    assert len(evs) == WORLD
+    want = (np.arange(4096, dtype=np.float32) * 0.5 * WORLD
+            + sum(range(WORLD)))
+    for r, (piped, serial) in results.items():
+        np.testing.assert_array_equal(piped, serial)
+        np.testing.assert_allclose(piped, want, rtol=1e-6)
