@@ -514,6 +514,22 @@ class _HybridGroupEngine:
         except ValueError:
             return 1 << 62
 
+    @classmethod
+    def _pipeline_eligible(cls, nbytes: int) -> bool:
+        """Engage window: [threshold, RING_MIN_BYTES). The upper cap
+        is a CORRECTNESS bound, not tuning: binomial-tree reduction is
+        elementwise-association-invariant under chunking (chunk
+        results equal the whole-buffer tree bitwise), but at ring
+        sizes the serial leg switches to ring order whose per-element
+        association depends on block boundaries — chunked rings would
+        diverge bitwise from the whole-buffer path and break the
+        cross-driver parity contract (collectives_generic.
+        ring_eligible). Above the cap the ring is already the
+        bandwidth-optimal leg; the pipeline's domain is the mid-size
+        regime."""
+        return (cls._pipeline_min_bytes() <= nbytes
+                < G.RING_MIN_BYTES)
+
     def _pipelined_leader_leg(self, total, op) -> Any:
         """Chunked overlap of the leader leg's two serial tiers: the
         leader runs the per-chunk TCP exchange in a producer thread
@@ -567,7 +583,8 @@ class _HybridGroupEngine:
                     if isinstance(item, BaseException):
                         raise item
                     out.append(item)
-            return np.concatenate(out).astype(dtype).reshape(shape)
+            return np.concatenate(out).astype(dtype,
+                                              copy=False).reshape(shape)
 
     def allreduce(self, data: Any, op="sum") -> Any:
         G.check_op(op)
@@ -590,7 +607,7 @@ class _HybridGroupEngine:
 
         if len(self._hosts) > 1 \
                 and isinstance(local_total, np.ndarray) \
-                and local_total.nbytes >= self._pipeline_min_bytes():
+                and self._pipeline_eligible(local_total.nbytes):
             return self._pipelined_leader_leg(local_total, op)
         return self._leader_leg(
             local_total, lambda t: G.allreduce(self._tcp_grp, t, op=op),

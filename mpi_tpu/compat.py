@@ -2736,6 +2736,33 @@ class _MPI:
 
         _spawn.close_port(port_name)
 
+    @staticmethod
+    def Publish_name(service_name: str, port_name: str,
+                     info: Any = None) -> None:
+        """``MPI_Publish_name``: register ``port_name`` under a
+        service name so ``Lookup_name`` finds it (host-scoped
+        file registry; ``info`` accepted and ignored)."""
+        from . import spawn as _spawn
+
+        _spawn.publish_name(service_name, port_name)
+
+    @staticmethod
+    def Unpublish_name(service_name: str, port_name: str = "",
+                       info: Any = None) -> None:
+        """``MPI_Unpublish_name``."""
+        from . import spawn as _spawn
+
+        _spawn.unpublish_name(service_name)
+
+    @staticmethod
+    def Lookup_name(service_name: str, info: Any = None) -> str:
+        """``MPI_Lookup_name``: the port published under
+        ``service_name`` (raises MPI_ERR_NAME-style when absent, as
+        mpi4py does)."""
+        from . import spawn as _spawn
+
+        return _spawn.lookup_name(service_name)
+
     def Get_version(self):
         """(major, minor) of the MPI standard surface this shim
         tracks. (4, 0): on top of the full MPI-3.1 core (nonblocking

@@ -42,7 +42,8 @@ from .comm import Comm
 from .intercomm import Intercomm, create_intercomm
 
 __all__ = ["spawn", "get_parent", "is_spawned", "disconnect",
-           "open_port", "close_port", "accept", "connect"]
+           "open_port", "close_port", "accept", "connect",
+           "publish_name", "unpublish_name", "lookup_name"]
 
 # Flag-protocol env overrides (flags.py ENV_*) that must NOT leak from
 # the parent's environment into a spawned child: the child's world is
@@ -524,3 +525,98 @@ def _join_bridge(comm: Comm, server_bridge: List[str],
                              client_bridge, is_parent=accepting)
     inter._bridge_net = bridge     # disconnect() tears this down
     return inter
+
+
+# --------------------------------------------------------------------------
+# Name service (MPI_Publish_name / MPI_Lookup_name / MPI_Unpublish_name):
+# the out-of-band channel the standard pairs with open_port — a server
+# publishes its port under a service name, clients look it up instead
+# of receiving the address through argv/files themselves.
+# --------------------------------------------------------------------------
+
+def _nameserver_dir() -> str:
+    """Single-host registry directory (one file per service name).
+    Override with MPI_TPU_NAMESERVER_DIR; the default lives under the
+    system temp dir so independent users on one machine share it the
+    way an ompi-server scoped to the host would."""
+    import tempfile
+
+    d = os.environ.get("MPI_TPU_NAMESERVER_DIR") or os.path.join(
+        tempfile.gettempdir(), "mpi_tpu_nameserver")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _service_path(service_name: str) -> str:
+    import hashlib
+
+    digest = hashlib.sha256(service_name.encode()).hexdigest()[:24]
+    return os.path.join(_nameserver_dir(), f"{digest}.json")
+
+
+def publish_name(service_name: str, port_name: str) -> None:
+    """MPI_Publish_name: make ``port_name`` discoverable under
+    ``service_name``. Re-publishing an ALREADY published name is an
+    error, per the standard (unpublish first)."""
+    import json as _json
+
+    path = _service_path(service_name)
+    # Write the full record to a private temp file, then hard-link it
+    # into place: link() is atomic AND exclusive, so concurrent
+    # publishers cannot both win, and no reader/duplicate-checker can
+    # ever observe a half-written registry file (an O_EXCL create
+    # followed by a separate write would wedge the name if the
+    # publisher died between the two: 'already published' to
+    # publishers, 'not found' to lookups).
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        _json.dump({"service": service_name, "port": port_name,
+                    "pid": os.getpid()}, f)
+    try:
+        os.link(tmp, path)
+    except FileExistsError:
+        raise MpiError(
+            f"mpi_tpu: service {service_name!r} is already published "
+            f"(MPI_ERR_SERVICE); unpublish_name it first")
+    finally:
+        os.unlink(tmp)
+
+
+def unpublish_name(service_name: str, port_name: Optional[str] = None
+                   ) -> None:
+    """MPI_Unpublish_name: withdraw a published service. Unpublishing
+    a name that is not published is an error, per the standard."""
+    try:
+        os.remove(_service_path(service_name))
+    except FileNotFoundError:
+        raise MpiError(
+            f"mpi_tpu: service {service_name!r} is not published "
+            f"(MPI_ERR_SERVICE)")
+
+
+def lookup_name(service_name: str, *,
+                timeout: float = 0.0) -> str:
+    """MPI_Lookup_name: the port published under ``service_name``.
+    Unpublished -> MpiError immediately (MPI_ERR_NAME), or after
+    ``timeout`` seconds of 100 ms polls when one is given (a client
+    racing its server's publish is the normal pattern)."""
+    import json as _json
+    import time as _time
+
+    path = _service_path(service_name)
+    deadline = _time.monotonic() + timeout
+    while True:
+        try:
+            with open(path) as f:
+                rec = _json.load(f)
+            if rec.get("service") == service_name:
+                return str(rec["port"])
+            # Hash-prefix collision with a different name: treat as
+            # not found (astronomically unlikely at 96 bits).
+        except (OSError, ValueError):
+            pass
+        if _time.monotonic() >= deadline:
+            raise MpiError(
+                f"mpi_tpu: no port published under {service_name!r} "
+                f"(MPI_ERR_NAME)")
+        _time.sleep(0.1)

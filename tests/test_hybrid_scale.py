@@ -302,3 +302,21 @@ def test_pipelined_large_allreduce_bitwise_matches_serial(monkeypatch):
     for r, (piped, serial) in results.items():
         np.testing.assert_array_equal(piped, serial)
         np.testing.assert_allclose(piped, want, rtol=1e-6)
+
+
+def test_pipeline_engage_window(monkeypatch):
+    """The pipeline window is [threshold, RING_MIN_BYTES): below, the
+    serial leg is cheaper; at ring sizes, chunking would change the
+    per-element reduction association and break the cross-driver
+    bitwise contract (correctness cap, not tuning)."""
+    from mpi_tpu import collectives_generic as gen
+    from mpi_tpu.backends.hybrid import _HybridGroupEngine as Eng
+
+    monkeypatch.setenv("MPI_TPU_HYBRID_PIPELINE_MIN", str(4 << 20))
+    assert not Eng._pipeline_eligible((4 << 20) - 1)
+    assert Eng._pipeline_eligible(4 << 20)
+    assert Eng._pipeline_eligible(gen.RING_MIN_BYTES - 1)
+    assert not Eng._pipeline_eligible(gen.RING_MIN_BYTES)
+    # Default: gate closed at every size.
+    monkeypatch.delenv("MPI_TPU_HYBRID_PIPELINE_MIN")
+    assert not Eng._pipeline_eligible(16 << 20)

@@ -255,3 +255,51 @@ class TestAcceptConnect:
             return out
 
         assert run_spmd(main, n=2) == [True, True]
+
+
+class TestNameService:
+    def test_publish_lookup_unpublish_roundtrip(self, tmp_path,
+                                                monkeypatch):
+        from mpi_tpu import spawn as _spawn
+        from mpi_tpu.compat import MPI
+
+        monkeypatch.setenv("MPI_TPU_NAMESERVER_DIR", str(tmp_path))
+        MPI.Publish_name("ocean", "127.0.0.1:12345")
+        assert MPI.Lookup_name("ocean") == "127.0.0.1:12345"
+        # Duplicate publish is MPI_ERR_SERVICE.
+        try:
+            MPI.Publish_name("ocean", "127.0.0.1:9")
+        except api.MpiError as exc:
+            assert "already published" in str(exc)
+        else:
+            raise AssertionError("duplicate publish accepted")
+        MPI.Unpublish_name("ocean")
+        # Gone: lookup is MPI_ERR_NAME, unpublish MPI_ERR_SERVICE.
+        try:
+            MPI.Lookup_name("ocean")
+        except api.MpiError as exc:
+            assert "no port published" in str(exc)
+        else:
+            raise AssertionError("lookup of unpublished name worked")
+        try:
+            MPI.Unpublish_name("ocean")
+        except api.MpiError as exc:
+            assert "not published" in str(exc)
+        else:
+            raise AssertionError("double unpublish accepted")
+
+    def test_lookup_timeout_covers_publish_race(self, tmp_path,
+                                                monkeypatch):
+        """A client may look up before its server publishes; the
+        timeout form polls through the race."""
+        import threading as th
+
+        from mpi_tpu import spawn as _spawn
+
+        monkeypatch.setenv("MPI_TPU_NAMESERVER_DIR", str(tmp_path))
+        timer = th.Timer(0.3, _spawn.publish_name, ("late", "h:1"))
+        timer.start()
+        try:
+            assert _spawn.lookup_name("late", timeout=5.0) == "h:1"
+        finally:
+            timer.cancel()
