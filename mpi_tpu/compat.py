@@ -57,8 +57,9 @@ v-variants (``Gatherv``/``Scatterv``/``Allgatherv``/``Alltoallv``)
 take the ``[buf, counts, displs, datatype]`` spec.
 
 Scope honesty: this is the commonly-used core surface, not all of
-mpi4py (no ``Create_struct`` across mixed dtypes — one base dtype per
-datatype; dynamic process management covers ``Comm.Spawn`` /
+mpi4py (``Create_struct`` handles mixed-base records with named-basic
+components — nest derived layouts via vector-of-struct, not
+struct-of-derived; dynamic process management covers ``Comm.Spawn`` /
 ``Get_parent`` / ``Disconnect`` and ``Open_port`` /
 ``Comm.Accept`` / ``Comm.Connect``; the MPI-4 Sessions surface
 (``MPI.Session.Init`` → psets → ``Group.Create_from_session_pset``
@@ -2133,6 +2134,11 @@ class Datatype:
         self._committed = committed
         self._predefined = False   # set True on the named module basics
         self._freed = False
+        # Struct datatypes (Create_struct) address the buffer's BYTES:
+        # base is uint8 and _flat views any-dtype buffers as bytes —
+        # only under this flag, so MPI.BYTE et al. keep the strict
+        # no-silent-reinterpretation contract.
+        self._struct = False
         # Dense prefix layouts pack/unpack as one slice, no gather.
         n = self._offsets.size
         self._contig = bool(n == self._extent_elems
@@ -2257,6 +2263,88 @@ class Datatype:
                             f"subarray({subsizes}@{starts} of {sizes})"
                             f"x{self._name}")
 
+    @staticmethod
+    def Create_struct(blocklengths, displacements,
+                      datatypes) -> "Datatype":
+        """Mixed-base records (``MPI_Type_create_struct``): block ``i``
+        is ``blocklengths[i]`` items of ``datatypes[i]`` at BYTE offset
+        ``displacements[i]`` — the numpy-structured-array layout, whose
+        field offsets feed ``displacements`` directly. The result
+        addresses the buffer's raw bytes (any buffer dtype works; a
+        structured record array is the natural one), so alignment
+        holes between fields never travel. Component datatypes must be
+        the named basics (identity layout — nest derived layouts via
+        ``Create_vector``-of-struct instead, as common MPI codes do)."""
+        blocklengths = [int(b) for b in blocklengths]
+        displacements = [int(d) for d in displacements]
+        if not (len(blocklengths) == len(displacements)
+                == len(datatypes)) or not blocklengths:
+            raise api.MpiError(
+                "mpi_tpu.compat: Create_struct needs equal-length "
+                "non-empty blocklengths/displacements/datatypes")
+        spans = []
+        for i, (bl, disp, dt) in enumerate(
+                zip(blocklengths, displacements, datatypes)):
+            if not isinstance(dt, Datatype):
+                raise api.MpiError(
+                    f"mpi_tpu.compat: Create_struct datatypes[{i}] is "
+                    f"not an MPI.Datatype")
+            if dt._offsets.size != 1 or dt._offsets[0] != 0 \
+                    or dt._extent_elems != 1:
+                # The extent check matters too: a RESIZED basic would
+                # pass the layout test but its MPI meaning (stride =
+                # resized extent between the block's elements) is not
+                # what the byte-span below builds — reject rather than
+                # silently lay records out differently from mpi4py.
+                raise api.MpiError(
+                    f"mpi_tpu.compat: Create_struct datatypes[{i}] "
+                    f"({dt!r}) is a derived layout; struct components "
+                    f"must be named basics")
+            if bl < 1 or disp < 0:
+                raise api.MpiError(
+                    f"mpi_tpu.compat: Create_struct block {i}: need "
+                    f"blocklength >= 1 and displacement >= 0, got "
+                    f"({bl}, {disp})")
+            spans.append(disp + np.arange(bl * dt._base.itemsize,
+                                          dtype=np.int64))
+        offsets = np.concatenate(spans)
+        if np.unique(offsets).size != offsets.size:
+            raise api.MpiError(
+                "mpi_tpu.compat: Create_struct blocks overlap "
+                "(a receive through this layout would be ambiguous)")
+        names = ",".join(f"{bl}x{dt._name}@{disp}" for bl, disp, dt in
+                         zip(blocklengths, displacements, datatypes))
+        out = Datatype(np.uint8, offsets,
+                       extent=int(offsets.max()) + 1,
+                       name=f"struct({names})", committed=False)
+        out._struct = True
+        return out
+
+    def Create_resized(self, lb: int, extent: int) -> "Datatype":
+        """``MPI_Type_create_resized``: same layout, caller-chosen
+        extent (bytes). Growing carries trailing padding (struct
+        records striding like the compiler's); SHRINKING interleaves
+        consecutive items — the textbook column-scatter pattern
+        ``Create_vector(n, 1, n).Create_resized(0, itemsize)``, which
+        this engine's index arithmetic supports directly. ``lb`` must
+        be 0 (layouts here are zero-based)."""
+        if lb != 0:
+            raise api.MpiError(
+                f"mpi_tpu.compat: Create_resized lb must be 0 here, "
+                f"got {lb}")
+        itemsize = self._base.itemsize
+        if extent <= 0 or extent % itemsize:
+            raise api.MpiError(
+                f"mpi_tpu.compat: Create_resized extent {extent} must "
+                f"be a positive multiple of the base itemsize "
+                f"({itemsize})")
+        out = Datatype(self._base, self._offsets.copy(),
+                       extent=extent // itemsize,
+                       name=f"resized({extent})x{self._name}",
+                       committed=False)
+        out._struct = self._struct
+        return out
+
     # -- pack / unpack ------------------------------------------------------
 
     def _flat(self, buf: Any, what: str, writable: bool) -> np.ndarray:
@@ -2264,6 +2352,18 @@ class Datatype:
         if writable:
             _writable_buffer(arr if isinstance(buf, np.ndarray) else buf,
                              what)
+        if self._struct and arr.dtype != self._base:
+            # A struct layout addresses raw bytes: view the buffer's
+            # storage (works for structured records and any plain
+            # dtype alike). The view needs contiguity; the writable
+            # path checks it below as usual.
+            if writable and not arr.flags.c_contiguous:
+                raise api.MpiError(
+                    f"mpi_tpu.compat: {what} needs a C-contiguous "
+                    f"receive buffer for a struct datatype")
+            arr = (arr if arr.flags.c_contiguous
+                   else np.ascontiguousarray(arr)).reshape(-1)
+            arr = arr.view(np.uint8)
         if arr.dtype != self._base:
             raise api.MpiError(
                 f"mpi_tpu.compat: {what} buffer dtype {arr.dtype} does "

@@ -537,13 +537,20 @@ def _join_bridge(comm: Comm, server_bridge: List[str],
 def _nameserver_dir() -> str:
     """Single-host registry directory (one file per service name).
     Override with MPI_TPU_NAMESERVER_DIR; the default lives under the
-    system temp dir so independent users on one machine share it the
-    way an ompi-server scoped to the host would."""
+    system temp dir, created sticky/world-writable like /tmp itself so
+    independent users on one machine can each publish (lookups cross
+    users; unpublishing ANOTHER user's service does not — same
+    ownership rule as files in /tmp)."""
     import tempfile
 
     d = os.environ.get("MPI_TPU_NAMESERVER_DIR") or os.path.join(
         tempfile.gettempdir(), "mpi_tpu_nameserver")
-    os.makedirs(d, exist_ok=True)
+    if not os.path.isdir(d):
+        os.makedirs(d, exist_ok=True)
+        try:
+            os.chmod(d, 0o1777)
+        except OSError:
+            pass  # someone else's dir with their perms: usable as-is
     return d
 
 
@@ -573,13 +580,49 @@ def publish_name(service_name: str, port_name: str) -> None:
         _json.dump({"service": service_name, "port": port_name,
                     "pid": os.getpid()}, f)
     try:
-        os.link(tmp, path)
-    except FileExistsError:
-        raise MpiError(
-            f"mpi_tpu: service {service_name!r} is already published "
-            f"(MPI_ERR_SERVICE); unpublish_name it first")
+        for attempt in (0, 1):
+            try:
+                os.link(tmp, path)
+                return
+            except FileExistsError:
+                if attempt == 0 and _reclaim_if_stale(path):
+                    continue  # dead publisher's entry removed: retry
+                raise MpiError(
+                    f"mpi_tpu: service {service_name!r} is already "
+                    f"published (MPI_ERR_SERVICE); unpublish_name it "
+                    f"first")
     finally:
         os.unlink(tmp)
+
+
+def _reclaim_if_stale(path: str) -> bool:
+    """True when ``path`` held a publisher that no longer exists and
+    was removed — a server that crashed without unpublishing must not
+    wedge its service name forever (its restart is the normal caller
+    here). Liveness = the recorded pid still exists on this host;
+    records without a readable pid are left alone."""
+    import json as _json
+
+    try:
+        with open(path) as f:
+            pid = int(_json.load(f)["pid"])
+    except (OSError, ValueError, KeyError, TypeError):
+        # Unreadable/half-gone: treat a VANISHED file as reclaimed
+        # (the race where the owner just unpublished), anything else
+        # as live — never delete what we can't attribute.
+        return not os.path.exists(path)
+    try:
+        os.kill(pid, 0)
+        return False          # publisher alive
+    except ProcessLookupError:
+        pass                  # dead: reclaim below
+    except PermissionError:
+        return False          # alive, other user
+    try:
+        os.remove(path)
+        return True
+    except OSError:
+        return False
 
 
 def unpublish_name(service_name: str, port_name: Optional[str] = None

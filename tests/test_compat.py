@@ -1764,3 +1764,109 @@ class TestSessions:
             return ok_range and ok_name and ok_fin
 
         assert run_spmd(main, n=2) == [True, True]
+
+
+class TestCreateStruct:
+    """Mixed-base records (MPI_Type_create_struct) + Create_resized:
+    the numpy-structured-array layout travels hole-free."""
+
+    def test_struct_roundtrip_skips_alignment_holes(self):
+        # i4 + f8: C alignment puts the double at offset 8 (4-byte
+        # hole). The wire form must carry 12 data bytes per record,
+        # never the hole.
+        rec = np.dtype([("id", "<i4"), ("x", "<f8")], align=True)
+        assert rec.itemsize == 16  # alignment hole present
+
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            dt = MPI.Datatype.Create_struct(
+                [1, 1],
+                [rec.fields["id"][1], rec.fields["x"][1]],
+                [MPI.INT, MPI.DOUBLE])
+            assert dt.Get_size() == 12          # data bytes only
+            dt = dt.Create_resized(0, rec.itemsize).Commit()
+            assert dt.Get_extent() == (0, 16)   # compiler stride
+            n = 3
+            if r == 0:
+                buf = np.zeros(n, dtype=rec)
+                buf["id"] = [10, 11, 12]
+                buf["x"] = [0.5, 1.5, 2.5]
+                comm.Send([buf, n, dt], dest=1, tag=21)
+                out = None
+            else:
+                got = np.zeros(n, dtype=rec)
+                got["id"] = -1                  # holes must survive
+                comm.Recv([got, n, dt], source=0, tag=21)
+                out = (got["id"].tolist(), got["x"].tolist())
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[1] == ([10, 11, 12], [0.5, 1.5, 2.5])
+
+    def test_struct_errors(self):
+        from mpi_tpu.compat import MPI
+
+        # Overlapping blocks are ambiguous on receive.
+        try:
+            MPI.Datatype.Create_struct([1, 1], [0, 2],
+                                       [MPI.INT, MPI.INT])
+        except api.MpiError as exc:
+            assert "overlap" in str(exc)
+        else:
+            raise AssertionError("overlapping struct accepted")
+        # Derived components are out of scope (documented).
+        vec = MPI.DOUBLE.Create_vector(2, 1, 3)
+        try:
+            MPI.Datatype.Create_struct([1], [0], [vec])
+        except api.MpiError as exc:
+            assert "named basics" in str(exc)
+        else:
+            raise AssertionError("derived component accepted")
+        # A RESIZED basic is a derived layout too: accepting it would
+        # silently build a different record layout than mpi4py's.
+        try:
+            MPI.Datatype.Create_struct(
+                [2], [0], [MPI.INT.Create_resized(0, 8)])
+        except api.MpiError as exc:
+            assert "named basics" in str(exc)
+        else:
+            raise AssertionError("resized struct component accepted")
+        # Resized: nonzero lb, zero extent, and non-itemsize-multiple
+        # extents rejected.
+        st = MPI.Datatype.Create_struct([1], [0], [MPI.INT])
+        for dt, bad in ((st, (4, 8)), (st, (0, 0)),
+                        (MPI.DOUBLE, (0, 4))):
+            try:
+                dt.Create_resized(*bad)
+            except api.MpiError:
+                pass
+            else:
+                raise AssertionError(f"Create_resized{bad} accepted")
+
+    def test_resized_column_scatter_pattern(self):
+        """The textbook shrink: vector(n,1,n).Create_resized(0,
+        itemsize) makes consecutive items the COLUMNS of an n x n
+        row-major matrix — the single most common real use of
+        MPI_Type_create_resized."""
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            n = 3
+            col = (MPI.DOUBLE.Create_vector(n, 1, n)
+                   .Create_resized(0, 8).Commit())
+            if r == 0:
+                mat = np.arange(n * n, dtype=np.float64).reshape(n, n)
+                comm.Send([mat, n, col], dest=1, tag=31)
+                out = None
+            else:
+                got = np.zeros((n, n), np.float64)
+                comm.Recv([got, n, col], source=0, tag=31)
+                out = got.tolist()
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        want = np.arange(9, dtype=np.float64).reshape(3, 3)
+        np.testing.assert_array_equal(np.asarray(res[1]), want)

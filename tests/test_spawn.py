@@ -288,6 +288,35 @@ class TestNameService:
         else:
             raise AssertionError("double unpublish accepted")
 
+    def test_stale_publish_reclaimed(self, tmp_path, monkeypatch):
+        """A publisher that died without unpublishing must not wedge
+        the name: the next publish reclaims the dead entry."""
+        import json
+        import os
+
+        from mpi_tpu import spawn as _spawn
+
+        monkeypatch.setenv("MPI_TPU_NAMESERVER_DIR", str(tmp_path))
+        _spawn.publish_name("phoenix", "h:1")
+        # Forge a dead publisher: rewrite the record with a pid that
+        # cannot exist (beyond pid_max).
+        path = _spawn._service_path("phoenix")
+        with open(path, "w") as f:
+            json.dump({"service": "phoenix", "port": "h:1",
+                       "pid": 2 ** 30}, f)
+        _spawn.publish_name("phoenix", "h:2")   # reclaims, no raise
+        assert _spawn.lookup_name("phoenix") == "h:2"
+        # A LIVE publisher (our own pid) still blocks duplicates.
+        with open(path, "w") as f:
+            json.dump({"service": "phoenix", "port": "h:2",
+                       "pid": os.getpid()}, f)
+        try:
+            _spawn.publish_name("phoenix", "h:3")
+        except api.MpiError as exc:
+            assert "already published" in str(exc)
+        else:
+            raise AssertionError("live duplicate publish accepted")
+
     def test_lookup_timeout_covers_publish_race(self, tmp_path,
                                                 monkeypatch):
         """A client may look up before its server publishes; the
