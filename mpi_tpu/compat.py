@@ -67,8 +67,9 @@ struct-of-derived; dynamic process management covers ``Comm.Spawn`` /
 see :class:`Session` for the honesty note; passive-target RMA
 (``Win.Lock``/``Unlock``/``Flush``) needs the window created with
 ``info={"locks": "true"}`` — see :meth:`Win.Create`; window
-displacements are element offsets into the exposed array, so
-``disp_unit`` is accepted only at its dtype-itemsize value).
+displacements scale by ``disp_unit`` exactly as in mpi4py, but the
+scaled byte offset must land element-aligned in the exposed array —
+no torn-element addressing).
 ``COMM_WORLD`` auto-initializes
 the framework on first use, matching mpi4py's import-time init
 ergonomics; call ``MPI.Finalize()`` (or ``mpi_tpu.finalize()``) at the
@@ -1508,11 +1509,14 @@ class Win:
     """mpi4py ``MPI.Win`` over :class:`mpi_tpu.window.Window` —
     active-target fence synchronization (``MPI_Win_fence`` epochs).
 
-    Target displacements are ELEMENT offsets into the exposed array
-    (the window's dtype defines the element); ``disp_unit`` is checked
-    against that dtype's itemsize rather than reinterpreted. ``Get``
-    and the fetching accumulates land in the caller's buffer at the
-    closing :meth:`Fence`, per the MPI completion rules."""
+    Target displacements are in units of ``disp_unit`` BYTES (MPI's
+    and mpi4py's exact semantics; the default ``disp_unit=1`` means
+    byte offsets — element-offset code passes
+    ``disp_unit=arr.dtype.itemsize``). The scaled byte offset must
+    land element-aligned in the exposed array (no torn elements;
+    checked per call). ``Get`` and the fetching accumulates land in
+    the caller's buffer at the closing :meth:`Fence`, per the MPI
+    completion rules."""
 
     def __init__(self, native):
         self._w = native
@@ -1535,15 +1539,22 @@ class Win:
         # np.asarray on a list would expose a detached COPY: remote
         # puts would land where the caller can never see them.
         mem = _writable_buffer(memory, "Win.Create")
-        if disp_unit not in (1, mem.dtype.itemsize):
+        if disp_unit < 1:
             raise api.MpiError(
-                f"mpi_tpu.compat: Win displacements are element offsets "
-                f"of dtype {mem.dtype}; disp_unit={disp_unit} conflicts "
-                f"with itemsize {mem.dtype.itemsize}")
+                f"mpi_tpu.compat: Win disp_unit must be >= 1, got "
+                f"{disp_unit}")
         locks = bool(info) and str(
             dict(info).get("locks", "false")).lower() == "true"
         c = (MPI.COMM_WORLD if comm is None else comm)._c
-        return cls(win_create(c, mem, locks=locks))
+        win = cls(win_create(c, mem, locks=locks))
+        # mpi4py-exact displacement semantics: target displacements
+        # are in units of disp_unit BYTES (default 1, MPI's own
+        # default) and must land element-aligned in the exposed array
+        # — checked per call in _disp. Element-offset code passes
+        # disp_unit=mem.dtype.itemsize, the portable mpi4py spelling.
+        win._disp_unit = int(disp_unit)
+        win._itemsize = int(mem.dtype.itemsize)
+        return win
 
     @property
     def native(self):
@@ -1555,8 +1566,7 @@ class Win:
         between fences)."""
         return self._w.local
 
-    @staticmethod
-    def _disp(target, origin_size: int) -> int:
+    def _disp(self, target, origin_size: int) -> int:
         # mpi4py spells the target as disp or [disp, count, datatype].
         # A count that disagrees with the origin buffer would silently
         # transfer the wrong span — fail loudly instead (this shim
@@ -1569,8 +1579,24 @@ class Win:
                     f"mpi_tpu.compat: target spec count {target[1]} != "
                     f"origin buffer size {origin_size}; this shim "
                     f"transfers exactly the origin's elements")
-            return int(target[0]) if target else 0
-        return int(target)
+            raw = int(target[0]) if target else 0
+        else:
+            raw = int(target)
+        # Displacements are disp_unit-BYTE offsets (mpi4py/MPI
+        # semantics; window attrs set in Create — default itemsize for
+        # windows built through the native layer directly).
+        unit = getattr(self, "_disp_unit", None)
+        itemsize = getattr(self, "_itemsize", None)
+        if unit is None or itemsize is None or unit == itemsize:
+            return raw
+        nbytes = raw * unit
+        if nbytes % itemsize:
+            raise api.MpiError(
+                f"mpi_tpu.compat: target displacement {raw} x "
+                f"disp_unit {unit} = byte offset {nbytes}, which is "
+                f"not aligned to the window dtype's itemsize "
+                f"{itemsize}")
+        return nbytes // itemsize
 
     def Put(self, origin: Any, target_rank: int, target=None) -> None:
         arr = np.asarray(origin)
@@ -2209,9 +2235,14 @@ class Datatype:
         pos = np.asarray(item_positions, dtype=np.int64).reshape(-1)
         offs = (pos[:, None] * self._extent_elems
                 + self._offsets[None, :]).reshape(-1)
-        return Datatype(self._base, offs,
-                        extent=extent_items * self._extent_elems,
-                        name=name, committed=False)
+        out = Datatype(self._base, offs,
+                       extent=extent_items * self._extent_elems,
+                       name=name, committed=False)
+        # Byte addressing is a property of the LAYOUT LINEAGE: a
+        # vector-of-struct (the documented nesting spelling) must keep
+        # viewing buffers as bytes, exactly like its component.
+        out._struct = self._struct
+        return out
 
     def Create_contiguous(self, count: int) -> "Datatype":
         if count < 1:
@@ -2445,7 +2476,17 @@ class Datatype:
         if self._contig:
             flat[:count * self._extent_elems] = data
         else:
-            flat[self._indices(count)] = data
+            idx = self._indices(count)
+            if np.unique(idx).size != idx.size:
+                # A shrunk extent can make consecutive items OVERLAP:
+                # legal to read (pack), ambiguous to write — numpy's
+                # fancy assignment would silently last-write-win
+                # (same stance as Create_struct's overlap rejection).
+                raise api.MpiError(
+                    f"mpi_tpu.compat: {what}: {count} items of "
+                    f"{self!r} overlap in the receive buffer — an "
+                    f"overlapping layout is ambiguous to write")
+            flat[idx] = data
 
 
 # Named basic datatypes (the C-name set mpi4py exposes, mapped onto the

@@ -599,30 +599,51 @@ def _reclaim_if_stale(path: str) -> bool:
     """True when ``path`` held a publisher that no longer exists and
     was removed — a server that crashed without unpublishing must not
     wedge its service name forever (its restart is the normal caller
-    here). Liveness = the recorded pid still exists on this host;
-    records without a readable pid are left alone."""
+    here). Liveness = the recorded pid still exists on this host.
+
+    An exclusive reclaim lock serializes concurrent reclaimers: a
+    read-then-remove without it could delete a RIVAL's freshly linked
+    record (both restarted publishers judging the same stale entry)
+    and let two publishes both 'succeed'. Losers simply report
+    already-published; inside the lock the only concurrent writers
+    are unpublish (remove -> our remove just misses) and publish
+    (link-only — cannot replace the file we judged)."""
     import json as _json
 
+    lock = f"{path}.reclaim"
     try:
-        with open(path) as f:
-            pid = int(_json.load(f)["pid"])
-    except (OSError, ValueError, KeyError, TypeError):
-        # Unreadable/half-gone: treat a VANISHED file as reclaimed
-        # (the race where the owner just unpublished), anything else
-        # as live — never delete what we can't attribute.
-        return not os.path.exists(path)
-    try:
-        os.kill(pid, 0)
-        return False          # publisher alive
-    except ProcessLookupError:
-        pass                  # dead: reclaim below
-    except PermissionError:
-        return False          # alive, other user
-    try:
-        os.remove(path)
-        return True
+        fd = os.open(lock, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+    except FileExistsError:
+        return False   # another reclaimer owns the verdict
     except OSError:
         return False
+    os.close(fd)
+    try:
+        try:
+            with open(path) as f:
+                pid = int(_json.load(f)["pid"])
+        except (OSError, ValueError, KeyError, TypeError):
+            # Unreadable/half-gone: a VANISHED file counts as
+            # reclaimed (the owner just unpublished); anything else
+            # as live — never delete what we can't attribute.
+            return not os.path.exists(path)
+        try:
+            os.kill(pid, 0)
+            return False          # publisher alive
+        except ProcessLookupError:
+            pass                  # dead: reclaim below
+        except PermissionError:
+            return False          # alive, other user
+        try:
+            os.remove(path)
+            return True
+        except OSError:
+            return False
+    finally:
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
 
 
 def unpublish_name(service_name: str, port_name: Optional[str] = None

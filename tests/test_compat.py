@@ -290,7 +290,10 @@ class TestWin:
             MPI, comm = _world()
             r, n = comm.Get_rank(), comm.Get_size()
             local = np.zeros(n, dtype=np.float64)
-            win = MPI.Win.Create(local, comm=comm)
+            # Element-offset targets need disp_unit=itemsize — the
+            # portable mpi4py spelling (the default disp_unit=1 means
+            # BYTE displacements, exactly as in mpi4py).
+            win = MPI.Win.Create(local, disp_unit=8, comm=comm)
             # Everyone writes (rank+1) into slot `r` of every peer.
             for t in range(n):
                 win.Put(np.array([r + 1.0]), t, target=r)
@@ -361,21 +364,35 @@ class TestWin:
 
         assert all(run_spmd(main, n=2))
 
-    def test_disp_unit_mismatch_raises(self):
+    def test_disp_unit_scaling_and_misalignment(self):
+        """Displacements are disp_unit-BYTE offsets (mpi4py
+        semantics): byte windows address elements directly, a
+        disp_unit=4 window over float64 scales 2 units -> element 1,
+        and an unaligned byte offset fails loudly at the call."""
         def main():
             MPI, comm = _world()
-            err = None
+            r, n = comm.Get_rank(), comm.Get_size()
+            local = np.zeros(2, dtype=np.float64)
+            win = MPI.Win.Create(local, disp_unit=4, comm=comm)
+            # 2 units x 4 bytes = byte 8 = element 1.
+            win.Put(np.array([float(r + 1)]), r, target=2)
+            win.Fence()
             try:
-                MPI.Win.Create(np.zeros(2, np.float64), disp_unit=4,
-                               comm=comm)
+                win.Put(np.array([1.0]), r, target=1)  # byte 4: torn
             except api.MpiError as e:
                 err = str(e)
-            comm.barrier()
+            else:
+                err = None
+            win.Fence()
+            out = (local.copy(), err)
+            win.Free()
             MPI.Finalize()
-            return err
+            return out
 
         res = run_spmd(main, n=2)
-        assert all(r and "disp_unit" in r for r in res)
+        for r, (got, err) in enumerate(res):
+            np.testing.assert_array_equal(got, [0.0, r + 1.0])
+            assert err and "not aligned" in err
 
 
 class TestFile:
@@ -1870,3 +1887,50 @@ class TestCreateStruct:
         res = run_spmd(main, n=2)
         want = np.arange(9, dtype=np.float64).reshape(3, 3)
         np.testing.assert_array_equal(np.asarray(res[1]), want)
+
+    def test_vector_of_struct_nesting(self):
+        """The docstring's recommended nesting: Create_vector OVER a
+        (resized) struct keeps byte addressing through _derive."""
+        rec = np.dtype([("a", "<i4"), ("b", "<f4")])  # packed, 8 B
+
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            st = (MPI.Datatype.Create_struct(
+                [1, 1], [0, 4], [MPI.INT, MPI.FLOAT])
+                .Create_resized(0, rec.itemsize))
+            # Every OTHER record of 4: items 0 and 2.
+            vec = st.Create_vector(2, 1, 2).Commit()
+            if r == 0:
+                buf = np.zeros(4, dtype=rec)
+                buf["a"] = [1, 2, 3, 4]
+                buf["b"] = [0.5, 1.5, 2.5, 3.5]
+                comm.Send([buf, 1, vec], dest=1, tag=41)
+                out = None
+            else:
+                got = np.zeros(4, dtype=rec)
+                comm.Recv([got, 1, vec], source=0, tag=41)
+                out = (got["a"].tolist(), got["b"].tolist())
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[1] == ([1, 0, 3, 0], [0.5, 0.0, 2.5, 0.0])
+
+    def test_overlapping_resized_receive_rejected(self):
+        """Shrinking the extent below the layout span makes items
+        overlap: legal to pack, ambiguous to write — the receive must
+        reject it instead of numpy last-write-wins corruption."""
+        from mpi_tpu.compat import MPI
+
+        st = (MPI.Datatype.Create_struct([1, 1], [0, 8],
+                                         [MPI.INT, MPI.INT])
+              .Create_resized(0, 2).Commit())
+        buf = np.zeros(32, np.uint8)
+        wire = np.zeros(16, np.uint8)
+        try:
+            st._unpack(buf, wire, 2, "test")
+        except api.MpiError as exc:
+            assert "overlap" in str(exc)
+        else:
+            raise AssertionError("overlapping receive accepted")
