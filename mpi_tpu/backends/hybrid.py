@@ -495,7 +495,7 @@ class _HybridGroupEngine:
     # serialized on the critical path, and on a real
     # multi-host fabric they use different resources (NIC vs local
     # memory), so overlap should approach max() of the tiers. On the
-    # one-core loopback box the A/B is contention noise (0.45x-1.25x
+    # one-core loopback box the A/B is 0.91x quiet / 0.45x-1.25x loaded
     # across runs, bench keys hybrid_allreduce_8MiB_*), so the gate
     # ships CLOSED — same discipline as quantized_eligible: the
     # default path must never lose at any measured size on the
