@@ -2376,6 +2376,93 @@ class Datatype:
         out._struct = self._struct
         return out
 
+    # -- explicit pack / unpack (MPI_Pack family) ---------------------------
+
+    def Pack_size(self, count: int, comm: Any = None) -> int:
+        """Upper bound (here: exact) bytes ``count`` items occupy in a
+        pack buffer (``MPI_Pack_size``; ``comm`` accepted and
+        ignored — the wire format is driver-independent)."""
+        return int(count) * self.Get_size()
+
+    def _pack_spec(self, spec: Any, what: str):
+        """(buf, count|None) through the SHARED spec grammar
+        (``_parse_spec``: bare array / [buf, count] / [buf, count,
+        datatype]); a datatype entry must be THIS datatype (MPI_Pack's
+        datatype is the method receiver) and counts must be >= 0 —
+        a negative count would silently slice the wrong span."""
+        buf, count, dt = _parse_spec(spec, what)
+        if dt is not None and dt is not self:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: spec names datatype "
+                f"{dt!r} but was invoked on {self!r} — MPI_Pack's "
+                f"datatype is the method receiver")
+        if count is not None and count < 0:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what}: count must be >= 0, got "
+                f"{count}")
+        return buf, count
+
+    @staticmethod
+    def _byte_view(spec: Any, what: str, writable: bool) -> np.ndarray:
+        """A Pack buffer (bare writable numpy array, any dtype) as a
+        flat byte view of its storage."""
+        buf = spec[0] if isinstance(spec, (list, tuple)) and spec \
+            else spec
+        arr = buf if isinstance(buf, np.ndarray) else np.asarray(buf)
+        if writable:
+            _writable_buffer(arr if isinstance(buf, np.ndarray)
+                             else buf, what)
+            if not arr.flags.c_contiguous:
+                raise api.MpiError(
+                    f"mpi_tpu.compat: {what} needs a C-contiguous "
+                    f"buffer")
+            return arr.reshape(-1).view(np.uint8)
+        return np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+
+    def Pack(self, inbuf: Any, outbuf: Any, position: int,
+             comm: Any = None) -> int:
+        """``MPI_Pack``: append ``inbuf`` (a bare array or
+        ``[buf, count]``, in THIS datatype's layout) to ``outbuf`` (a
+        writable numpy array — bytes are written through its storage)
+        at byte ``position``; returns the new position. Heterogeneous
+        messages pack by calling this with each datatype in turn,
+        sharing one position cursor."""
+        buf, count = self._pack_spec(inbuf, "Pack")
+        data = self._pack(buf, count, "Pack")
+        raw = np.ascontiguousarray(data).view(np.uint8)
+        out = self._byte_view(outbuf, "Pack", writable=True)
+        position = int(position)
+        if position < 0 or position + raw.size > out.size:
+            raise api.MpiError(
+                f"mpi_tpu.compat: Pack of {raw.size} bytes at position "
+                f"{position} overruns the {out.size}-byte buffer")
+        out[position:position + raw.size] = raw
+        return position + raw.size
+
+    def Unpack(self, inbuf: Any, position: int, outbuf: Any,
+               comm: Any = None) -> int:
+        """``MPI_Unpack``: the inverse — read items of THIS datatype
+        from ``inbuf`` at byte ``position`` into ``outbuf`` (a bare
+        array or ``[buf, count]``; a bare array unpacks as many whole
+        items as it holds); returns the new position."""
+        src = self._byte_view(inbuf, "Unpack", writable=False)
+        buf, count = self._pack_spec(outbuf, "Unpack")
+        if count is None:
+            # writable=True: fail fast on a read-only/strided
+            # destination here, instead of copying it just to size it
+            # and erroring later in the real unpack.
+            flat = self._flat(buf, "Unpack", writable=True)
+            count = self._infer_count(flat.size, "Unpack")
+        nbytes = count * self.Get_size()
+        position = int(position)
+        if position < 0 or position + nbytes > src.size:
+            raise api.MpiError(
+                f"mpi_tpu.compat: Unpack of {nbytes} bytes at position "
+                f"{position} overruns the {src.size}-byte buffer")
+        data = src[position:position + nbytes].view(self._base)
+        self._unpack(buf, data, count, "Unpack")
+        return position + nbytes
+
     # -- pack / unpack ------------------------------------------------------
 
     def _flat(self, buf: Any, what: str, writable: bool) -> np.ndarray:

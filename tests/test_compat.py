@@ -1934,3 +1934,87 @@ class TestCreateStruct:
             assert "overlap" in str(exc)
         else:
             raise AssertionError("overlapping receive accepted")
+
+
+class TestPackUnpack:
+    """MPI_Pack / MPI_Unpack / MPI_Pack_size: heterogeneous message
+    assembly with one shared position cursor, through the datatype
+    layout engine."""
+
+    def test_heterogeneous_pack_roundtrip_over_the_wire(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            n_i, n_d = 3, 2
+            size = (MPI.INT.Pack_size(n_i) + MPI.DOUBLE.Pack_size(n_d))
+            if r == 0:
+                ints = np.array([5, 6, 7], np.int32)
+                dbls = np.array([2.5, 3.5], np.float64)
+                buf = np.zeros(size, np.uint8)
+                pos = MPI.INT.Pack([ints, n_i], buf, 0)
+                pos = MPI.DOUBLE.Pack([dbls, n_d], buf, pos)
+                assert pos == size
+                comm.Send([buf, size, MPI.BYTE], dest=1, tag=51)
+                out = None
+            else:
+                buf = np.zeros(size, np.uint8)
+                comm.Recv([buf, size, MPI.BYTE], source=0, tag=51)
+                ints = np.zeros(3, np.int32)
+                dbls = np.zeros(2, np.float64)
+                pos = MPI.INT.Unpack(buf, 0, [ints, n_i])
+                pos = MPI.DOUBLE.Unpack(buf, pos, [dbls, n_d])
+                assert pos == size
+                out = (ints.tolist(), dbls.tolist())
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[1] == ([5, 6, 7], [2.5, 3.5])
+
+    def test_pack_derived_layout_and_bounds(self):
+        from mpi_tpu.compat import MPI
+
+        # A strided column packs dense: 3 doubles = 24 bytes.
+        col = MPI.DOUBLE.Create_vector(3, 1, 3).Commit()
+        mat = np.arange(9, dtype=np.float64).reshape(3, 3)
+        buf = np.zeros(col.Pack_size(1), np.uint8)
+        pos = col.Pack([mat, 1], buf, 0)
+        assert pos == 24
+        np.testing.assert_array_equal(buf.view(np.float64), [0., 3., 6.])
+        # Unpack scatters back through the stride.
+        got = np.zeros((3, 3), np.float64)
+        assert col.Unpack(buf, 0, [got, 1]) == 24
+        np.testing.assert_array_equal(got[:, 0], [0., 3., 6.])
+        assert got[:, 1:].sum() == 0
+        # Overrun fails loudly both ways.
+        small = np.zeros(10, np.uint8)
+        for fn in (lambda: col.Pack([mat, 1], small, 0),
+                   lambda: col.Unpack(small, 0, [got, 1])):
+            try:
+                fn()
+            except api.MpiError as exc:
+                assert "overruns" in str(exc)
+            else:
+                raise AssertionError("overrun accepted")
+
+    def test_pack_spec_grammar_guards(self):
+        from mpi_tpu.compat import MPI
+
+        ints = np.array([1, 2, 3], np.int32)
+        buf = np.zeros(12, np.uint8)
+        # [buf, count, datatype] with the RECEIVER's datatype: fine.
+        assert MPI.INT.Pack([ints, 3, MPI.INT], buf, 0) == 12
+        # A different datatype in the spec is a contradiction.
+        try:
+            MPI.INT.Pack([ints, 3, MPI.DOUBLE], buf, 0)
+        except api.MpiError as exc:
+            assert "method receiver" in str(exc)
+        else:
+            raise AssertionError("mismatched spec datatype accepted")
+        # Negative counts must not silently slice the wrong span.
+        try:
+            MPI.INT.Pack([ints, -1], buf, 0)
+        except api.MpiError as exc:
+            assert ">= 0" in str(exc)
+        else:
+            raise AssertionError("negative count accepted")
