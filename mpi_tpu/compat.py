@@ -908,6 +908,60 @@ class Comm:
     def exscan(self, sendobj: Any, op: "Op" = None) -> Optional[Any]:
         return self._c.exscan(sendobj, op=_op(op))
 
+    def _scan_payload(self, sendbuf: Any, recvbuf: Any,
+                      what: str) -> np.ndarray:
+        # IN_PLACE reads the contribution from recvbuf — which fill()
+        # will MUTATE while slower rank-threads are still prefix-
+        # folding the aliased in-process payload (the scan engines
+        # fold per-rank AFTER the allgather rendezvous, unlike
+        # Allreduce's combine-inside-the-rendezvous). ONE snapshot
+        # copy breaks the alias, exactly as Sendrecv_replace does.
+        if sendbuf is IN_PLACE:
+            return np.array(_spec_payload(recvbuf, what), copy=True)
+        return _spec_payload(sendbuf, what)
+
+    def Scan(self, sendbuf: Any, recvbuf: Any, op: "Op" = None) -> None:
+        """Buffer-form inclusive prefix reduction (``MPI_Scan``);
+        ``sendbuf=MPI.IN_PLACE`` reads this rank's contribution from
+        ``recvbuf``, mpi4py semantics."""
+        target = _RecvTarget(recvbuf, "Scan")
+        payload = self._scan_payload(sendbuf, recvbuf, "Scan")
+        target.fill(self._c.scan(payload, op=_op(op)))
+
+    def Exscan(self, sendbuf: Any, recvbuf: Any, op: "Op" = None
+               ) -> None:
+        """Buffer-form EXCLUSIVE prefix reduction (``MPI_Exscan``).
+        Rank 0's receive buffer is left untouched (its exclusive
+        prefix is undefined, per MPI)."""
+        target = _RecvTarget(recvbuf, "Exscan")
+        payload = self._scan_payload(sendbuf, recvbuf, "Exscan")
+        out = self._c.exscan(payload, op=_op(op))
+        if out is not None:
+            target.fill(out)
+
+    def Split_type(self, split_type: int = 1, key: int = 0,
+                   info: Any = None) -> Optional["Comm"]:
+        """``MPI_Comm_split_type`` with ``MPI.COMM_TYPE_SHARED`` (the
+        only standard type): one communicator per shared-memory
+        domain — here the driver's host grouping (``split_type
+        ("host")``), which is exactly the shared-memory boundary on
+        the hybrid driver and the whole world on single-host drivers.
+        ``MPI.UNDEFINED`` participates in the collective and returns
+        ``COMM_NULL`` (``None``), per MPI — raising instead would
+        deadlock the ranks that did ask for a grouping. ``info``
+        accepted and ignored."""
+        if split_type == UNDEFINED:
+            # split_type('host') IS split(color=host_key): color=None
+            # joins that same collective as a non-member.
+            out = self._c.split(color=None, key=key)
+            return None if out is None else Comm(out)
+        if split_type != COMM_TYPE_SHARED:
+            raise api.MpiError(
+                f"mpi_tpu.compat: Split_type supports "
+                f"MPI.COMM_TYPE_SHARED or MPI.UNDEFINED, got "
+                f"{split_type}")
+        return Comm(self._c.split_type("host", key=key))
+
     # -- nonblocking collectives (lowercase pickle, mpi4py-style) -----------
     #
     # Each returns a Request whose wait() yields what the blocking
@@ -2031,6 +2085,8 @@ PROC_NULL = -3
 ROOT_SENTINEL = -4
 # MPI.UNDEFINED: Group rank queries for processes outside the group.
 UNDEFINED = -32766
+# MPI_Comm_split_type's standard type (shared-memory domain).
+COMM_TYPE_SHARED = 1
 # MPI.COMM_NULL: what Get_parent returns in a non-spawned process.
 # None, so the mpi4py gate `parent != MPI.COMM_NULL` works: a real
 # Intercomm compares unequal to None, and a non-spawned process's
@@ -2794,6 +2850,7 @@ class _MPI:
     ROOT = ROOT_SENTINEL
     UNDEFINED = UNDEFINED
     COMM_NULL = COMM_NULL
+    COMM_TYPE_SHARED = COMM_TYPE_SHARED
     IN_PLACE = IN_PLACE
     ORDER_C = ORDER_C
     ORDER_F = ORDER_F

@@ -2018,3 +2018,66 @@ class TestPackUnpack:
             assert ">= 0" in str(exc)
         else:
             raise AssertionError("negative count accepted")
+
+
+class TestScanSplitType:
+    def test_uppercase_scan_exscan_buffer_forms(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            send = np.array([float(r + 1), 2.0 * (r + 1)])
+            inc = np.zeros(2)
+            comm.Scan(send, inc)
+            exc = np.full(2, -7.0)   # rank 0's must stay untouched
+            comm.Exscan(send, exc)
+            # IN_PLACE form: contribution read from recvbuf.
+            inp = send.copy()
+            comm.Scan(MPI.IN_PLACE, inp)
+            MPI.Finalize()
+            return inc.tolist(), exc.tolist(), inp.tolist()
+
+        res = run_spmd(main, n=3)
+        for r, (inc, exc, inp) in enumerate(res):
+            pref = sum(range(1, r + 2))          # 1+..+(r+1)
+            assert inc == [pref, 2.0 * pref] == inp
+            if r == 0:
+                assert exc == [-7.0, -7.0]       # untouched
+            else:
+                epref = sum(range(1, r + 1))
+                assert exc == [epref, 2.0 * epref]
+
+    def test_split_type_shared(self):
+        def main():
+            MPI, comm = _world()
+            node = comm.Split_type(MPI.COMM_TYPE_SHARED)
+            out = (node.Get_size(), node.allreduce(1))
+            try:
+                comm.Split_type(42)
+            except api.MpiError:
+                ok = True
+            else:
+                ok = False
+            MPI.Finalize()
+            return out + (ok,)
+
+        res = run_spmd(main, n=3)
+        # xla driver: all rank-threads share one host.
+        assert res == [(3, 3, True)] * 3
+
+    def test_split_type_undefined_participates(self):
+        """UNDEFINED ranks must join the collective and get COMM_NULL
+        — raising instead would deadlock the grouping ranks."""
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            if r == 1:
+                node = comm.Split_type(MPI.UNDEFINED)
+                out = node  # None == COMM_NULL
+            else:
+                node = comm.Split_type(MPI.COMM_TYPE_SHARED)
+                out = node.Get_size()
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=3)
+        assert res[1] is None and res[0] == 2 and res[2] == 2
