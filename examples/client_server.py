@@ -1,0 +1,92 @@
+"""client_server — two independent worlds joined at runtime
+(MPI_Open_port / MPI_Comm_accept / MPI_Comm_connect demo).
+
+No reference analogue (btracey/mpi fixes the world at init,
+network.go:94-118). Unlike ``examples/spawn.py`` — where a running
+world LAUNCHES its workers — here the server and client groups start
+independently (different launchers, different times) and rendezvous
+through a port name advertised in the host-scoped name service
+(``MPI_Publish_name`` / ``MPI_Lookup_name``), the pattern MPI
+reserves for long-lived services.
+
+Run::
+
+    python -m mpi_tpu.launch.mpirun 2 examples/client_server.py
+
+The launcher starts the 2-rank SERVER world; the server's rank 0 then
+starts a separate 2-process CLIENT world (raw flag ABI — any second
+launcher works the same), which discovers the port via the name
+service and connects. Work flows client -> server over the intercomm;
+both sides ``Disconnect`` when done.
+"""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mpi_tpu.compat import MPI
+
+SERVICE = "mpi-tpu-demo-service"
+
+
+def client() -> None:
+    from mpi_tpu import spawn as _spawn
+
+    comm = MPI.COMM_WORLD
+    # Poll through the race with the server's Publish_name.
+    port = _spawn.lookup_name(SERVICE, timeout=30.0)
+    inter = comm.Connect(port)
+    me = comm.Get_rank()
+    inter.send(("work-result", me, me * 111), dest=0, tag=7)
+    print(f"client {me}/{comm.Get_size()}: connected via "
+          f"{SERVICE!r} and sent", flush=True)
+    inter.Disconnect()
+    MPI.Finalize()
+
+
+def server() -> None:
+    from mpi_tpu import spawn as _spawn
+
+    comm = MPI.COMM_WORLD
+    me, n = comm.Get_rank(), comm.Get_size()
+    procs = []
+    if me == 0:
+        port = MPI.Open_port()
+        MPI.Publish_name(SERVICE, port)
+        # Start the independent client world (stands in for a second
+        # launcher invocation elsewhere on the host).
+        addrs = _spawn._alloc_addrs(2)
+        env = {**os.environ}
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["MPI_TPU_CLIENT_ROLE"] = "1"
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--mpi-addr", a, "--mpi-alladdr", ",".join(sorted(addrs)),
+             "--mpi-protocol", "tcp", "--mpi-inittimeout", "60s"],
+            env=env) for a in addrs]
+    # Collective accept: every server rank participates.
+    port = comm.bcast(port if me == 0 else None, root=0)
+    inter = comm.Accept(port)
+    if me == 0:
+        got = sorted(inter.recv(source=i, tag=7) for i in range(2))
+        assert got == [("work-result", 0, 0), ("work-result", 1, 111)]
+        print(f"server 0/{n}: accepted a {inter.Get_remote_size()}-rank "
+              f"client world, results OK", flush=True)
+        MPI.Unpublish_name(SERVICE)
+        for p in procs:
+            assert p.wait(60) == 0
+    else:
+        print(f"server {me}/{n}: joined the accept collective — OK",
+              flush=True)
+    inter.Disconnect()
+    MPI.Finalize()
+
+
+if __name__ == "__main__":
+    if os.environ.get("MPI_TPU_CLIENT_ROLE"):
+        client()
+    else:
+        server()

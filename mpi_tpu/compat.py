@@ -374,6 +374,12 @@ class _FillOnWaitRequest(Request):
     Test = test
 
 
+# MPI_Comm_split_type's standard type (shared-memory domain) — defined
+# before Comm so Split_type's signature can default to it, like
+# mpi4py's.
+COMM_TYPE_SHARED = 1
+
+
 class Comm:
     """mpi4py-flavoured view over a native communicator."""
 
@@ -939,7 +945,8 @@ class Comm:
         if out is not None:
             target.fill(out)
 
-    def Split_type(self, split_type: int = 1, key: int = 0,
+    def Split_type(self, split_type: int = COMM_TYPE_SHARED,
+                   key: int = 0,
                    info: Any = None) -> Optional["Comm"]:
         """``MPI_Comm_split_type`` with ``MPI.COMM_TYPE_SHARED`` (the
         only standard type): one communicator per shared-memory
@@ -952,9 +959,10 @@ class Comm:
         accepted and ignored."""
         if split_type == UNDEFINED:
             # split_type('host') IS split(color=host_key): color=None
-            # joins that same collective as a non-member.
-            out = self._c.split(color=None, key=key)
-            return None if out is None else Comm(out)
+            # joins that same collective as a non-member, which by
+            # split's contract always yields no communicator.
+            self._c.split(color=None, key=key)
+            return None
         if split_type != COMM_TYPE_SHARED:
             raise api.MpiError(
                 f"mpi_tpu.compat: Split_type supports "
@@ -2085,8 +2093,6 @@ PROC_NULL = -3
 ROOT_SENTINEL = -4
 # MPI.UNDEFINED: Group rank queries for processes outside the group.
 UNDEFINED = -32766
-# MPI_Comm_split_type's standard type (shared-memory domain).
-COMM_TYPE_SHARED = 1
 # MPI.COMM_NULL: what Get_parent returns in a non-spawned process.
 # None, so the mpi4py gate `parent != MPI.COMM_NULL` works: a real
 # Intercomm compares unequal to None, and a non-spawned process's

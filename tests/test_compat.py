@@ -2030,21 +2030,29 @@ class TestScanSplitType:
             comm.Scan(send, inc)
             exc = np.full(2, -7.0)   # rank 0's must stay untouched
             comm.Exscan(send, exc)
-            # IN_PLACE form: contribution read from recvbuf.
+            # IN_PLACE forms: contribution read from recvbuf (the
+            # snapshot copy keeps slower rank-threads' folds off the
+            # aliased payload — both ops exercise it).
             inp = send.copy()
             comm.Scan(MPI.IN_PLACE, inp)
+            exp = send.copy()
+            comm.Exscan(MPI.IN_PLACE, exp)
             MPI.Finalize()
-            return inc.tolist(), exc.tolist(), inp.tolist()
+            return (inc.tolist(), exc.tolist(), inp.tolist(),
+                    exp.tolist())
 
         res = run_spmd(main, n=3)
-        for r, (inc, exc, inp) in enumerate(res):
+        for r, (inc, exc, inp, exp) in enumerate(res):
             pref = sum(range(1, r + 2))          # 1+..+(r+1)
             assert inc == [pref, 2.0 * pref] == inp
             if r == 0:
                 assert exc == [-7.0, -7.0]       # untouched
+                # IN_PLACE rank 0: recvbuf keeps its contribution
+                # (Exscan leaves it undefined-per-MPI = untouched).
+                assert exp == [1.0, 2.0]
             else:
                 epref = sum(range(1, r + 1))
-                assert exc == [epref, 2.0 * epref]
+                assert exc == [epref, 2.0 * epref] == exp
 
     def test_split_type_shared(self):
         def main():
