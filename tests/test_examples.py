@@ -13,13 +13,14 @@ from conftest import _free_port_block
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _mpirun(n, prog, *prog_args, timeout=120):
+def _mpirun(n, prog, *prog_args, timeout=120, env=None):
     port = _free_port_block(4)
     return subprocess.run(
         [sys.executable, "-m", "mpi_tpu.launch.mpirun",
          "--port-base", str(port), "--timeout", "30",
          str(n), prog, *prog_args],
-        cwd=REPO, capture_output=True, text=True, timeout=timeout)
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env=env)
 
 
 @pytest.mark.integration
@@ -112,3 +113,28 @@ class TestSsmExample:
             capture_output=True, text=True, timeout=420, cwd=REPO)
         assert res.returncode == 0, res.stderr[-800:] + res.stdout[-400:]
         assert "ssm example OK" in res.stdout
+
+
+@pytest.mark.integration
+class TestDynamicProcessExamples:
+    def test_spawn_master_worker(self):
+        """examples/spawn.py: 2 parents spawn 3 workers at runtime;
+        the parents' assertion verifies the gathered sum."""
+        res = _mpirun(2, "examples/spawn.py", timeout=180)
+        assert res.returncode == 0, res.stderr[-800:]
+        assert "3 spawned workers summed" in res.stdout
+
+    def test_client_server_rendezvous(self, tmp_path):
+        """examples/client_server.py: an independent client world
+        discovers the server's port through the name service and
+        connects. The registry is pointed at a per-test dir — the
+        example's fixed service name lives in a HOST-global registry
+        by default, and two concurrent test runs on one machine would
+        collide there (live-duplicate publish raises)."""
+        import os
+
+        env = {**os.environ, "MPI_TPU_NAMESERVER_DIR": str(tmp_path)}
+        res = _mpirun(2, "examples/client_server.py", timeout=180,
+                      env=env)
+        assert res.returncode == 0, res.stderr[-800:]
+        assert "accepted a 2-rank client world" in res.stdout
