@@ -1,7 +1,7 @@
 #!/bin/bash
 # Probe the axon tunnel every 8 min; log to TUNNEL_LOG.md. On a
 # successful probe: run the headline-only bench FIRST (MFU lands in the
-# window's first minutes), persist it as BENCH_MANUAL_r04.json, then
+# window's first minutes), persist it as BENCH_MANUAL_r05.json, then
 # run the full bench and upgrade the capture — only ever overwriting
 # with a line that actually carries a TPU headline (platform=tpu and a
 # nonzero value), so a failed full run can never destroy a good
@@ -9,42 +9,56 @@
 cd /root/repo
 
 is_tpu_line() {
+  # Accept any genuine on-chip headline: value (MFU) when the chip
+  # kind is in the peak table, else tokens/s (an unknown device_kind
+  # honestly reports mfu null + value 0.0 — that capture is still
+  # rare tunnel-window evidence and must never be discarded).
   echo "$1" | python -c 'import json,sys
 try:
     d = json.loads(sys.stdin.read())
 except ValueError:
     sys.exit(1)
-sys.exit(0 if d.get("platform") == "tpu" and d.get("value") else 1)'
+ok = (d.get("platform") == "tpu"
+      and not d.get("error")
+      and (d.get("value") or d.get("train_tokens_per_s")))
+sys.exit(0 if ok else 1)'
 }
 
 while true; do
+  # Yield to any foreign bench run (the driver's end-of-round run, a
+  # test-suite smoke): the probe's python process competes for the
+  # box's single core and measurably skews host-side timing legs.
+  if pgrep -f "[b]ench.py" > /dev/null 2>&1; then
+    sleep 120
+    continue
+  fi
   if timeout 75 python -c "import jax,jax.numpy as jnp; jnp.ones((128,128)).sum().block_until_ready()" > /dev/null 2>&1; then
     echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): probe OK (watch loop)" >> TUNNEL_LOG.md
-    if [ ! -f BENCH_MANUAL_r04.json ]; then
+    if [ ! -f BENCH_MANUAL_r05.json ]; then
       echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): tunnel up -> headline-only capture" >> TUNNEL_LOG.md
       MPI_TPU_BENCH_DEADLINE_S=900 timeout 1000 python bench.py --headline-only > /tmp/bench_hl.out 2> /tmp/bench_hl.err
       rc=$?
       line=$(grep -a '^{' /tmp/bench_hl.out | tail -1)
       if [ -n "$line" ] && is_tpu_line "$line"; then
-        echo "$line" > BENCH_MANUAL_r04.json
-        cp BENCH_FULL.json BENCH_MANUAL_r04_full.json 2>/dev/null
-        cp /tmp/bench_hl.err BENCH_MANUAL_r04.stderr.log 2>/dev/null
-        echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): headline capture rc=$rc -> BENCH_MANUAL_r04.json" >> TUNNEL_LOG.md
+        echo "$line" > BENCH_MANUAL_r05.json
+        cp BENCH_FULL.json BENCH_MANUAL_r05_full.json 2>/dev/null
+        cp /tmp/bench_hl.err BENCH_MANUAL_r05.stderr.log 2>/dev/null
+        echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): headline capture rc=$rc -> BENCH_MANUAL_r05.json" >> TUNNEL_LOG.md
       else
         echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): headline capture rc=$rc yielded no TPU line" >> TUNNEL_LOG.md
       fi
     fi
-    if [ -f BENCH_MANUAL_r04.json ] && [ ! -f /tmp/bench_fullrun_r04.done ]; then
+    if [ -f BENCH_MANUAL_r05.json ] && [ ! -f /tmp/bench_fullrun_r05.done ]; then
       echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): tunnel up -> full bench capture" >> TUNNEL_LOG.md
       MPI_TPU_BENCH_DEADLINE_S=3000 timeout 3300 python bench.py > /tmp/bench_manual.out 2> /tmp/bench_manual.err
       rc=$?
       line=$(grep -a '^{' /tmp/bench_manual.out | tail -1)
       if [ -n "$line" ] && is_tpu_line "$line"; then
-        echo "$line" > BENCH_MANUAL_r04.json
-        cp BENCH_FULL.json BENCH_MANUAL_r04_full.json 2>/dev/null
-        cp /tmp/bench_manual.err BENCH_MANUAL_r04.stderr.log 2>/dev/null
-        touch /tmp/bench_fullrun_r04.done
-        echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): full bench rc=$rc -> BENCH_MANUAL_r04.json (upgraded)" >> TUNNEL_LOG.md
+        echo "$line" > BENCH_MANUAL_r05.json
+        cp BENCH_FULL.json BENCH_MANUAL_r05_full.json 2>/dev/null
+        cp /tmp/bench_manual.err BENCH_MANUAL_r05.stderr.log 2>/dev/null
+        touch /tmp/bench_fullrun_r05.done
+        echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): full bench rc=$rc -> BENCH_MANUAL_r05.json (upgraded)" >> TUNNEL_LOG.md
       else
         echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): full bench rc=$rc kept no TPU line" >> TUNNEL_LOG.md
       fi
