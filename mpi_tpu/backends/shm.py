@@ -395,6 +395,31 @@ class ShmConn:
             raise socket.timeout(f"shm {what} timed out")
         return max(1, int(left * 1000))
 
+    def _native_send(self, what: str, call) -> None:
+        """Run one resumable native ring op to completion: EINTR
+        resumes (returning to the interpreter so pending Python signal
+        handlers run between resumes), a Python-side deadline expiry
+        abandons the op exactly like a native -ETIMEDOUT would
+        (poisoning if that strands the stream mid-frame), and native
+        rc values map to the same exceptions everywhere. ``call`` is
+        ``(lib, timeout_ms) -> rc``."""
+        lib = _native.shmcore()
+        deadline = self._deadline()
+        try:
+            while True:
+                rc = call(lib, self._remaining_ms(deadline, what))
+                if rc != -_errno.EINTR:
+                    break
+        except socket.timeout:
+            lib.shm_abandon(self._tx._h, 0)
+            raise
+        if rc == _native.PEER_CLOSED:
+            raise ConnectionError("shm ring closed by peer")
+        if rc == -_errno.ETIMEDOUT:
+            raise socket.timeout(f"shm {what} timed out")
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc))
+
     def send_frame(self, kind: int, tag: int, payload: bytes = b"") -> None:
         if len(payload) > 0xFFFFFFFF:
             # The wire length field is u32; ctypes would silently
@@ -404,36 +429,48 @@ class ShmConn:
                 f"exceeds the u32 wire limit")
         tx = self._tx
         if isinstance(tx, _NativeRing):
-            lib = _native.shmcore()
             buf = bytes(payload) if not isinstance(payload, bytes) else payload
-            deadline = self._deadline()
-            try:
-                while True:
-                    rc = lib.shm_send_frame(
-                        tx._h, kind, tag, buf, len(buf),
-                        self._remaining_ms(deadline, "send"))
-                    if rc != -_errno.EINTR:
-                        break
-                    # returning to the interpreter here runs pending
-                    # Python signal handlers (Ctrl+C); the op resumes
-            except socket.timeout:
-                # Python-side deadline expiry between -EINTR resumes
-                # abandons the op exactly like a native -ETIMEDOUT
-                # would: poison if that strands the stream mid-frame.
-                lib.shm_abandon(tx._h, 0)
-                raise
-            if rc == _native.PEER_CLOSED:
-                raise ConnectionError("shm ring closed by peer")
-            if rc == -_errno.ETIMEDOUT:
-                raise socket.timeout("shm send timed out")
-            if rc != 0:
-                raise OSError(-rc, os.strerror(-rc))
+            self._native_send("send", lambda lib, ms: lib.shm_send_frame(
+                tx._h, kind, tag, buf, len(buf), ms))
             return
         deadline = self._deadline()
         header = _FRAME_HDR.pack(kind, tag, len(payload))
         tx.write(memoryview(header), deadline)
         if payload:
             tx.write(memoryview(payload), deadline)
+
+    def send_frame2(self, kind: int, tag: int, prefix: bytes,
+                    view) -> None:
+        """One frame whose body is ``prefix + view``, streamed without
+        concatenation — the shm side of the codec's zero-copy ndarray
+        path (``encode_parts``). The receiver sees an ordinary frame
+        of the combined length."""
+        mv = memoryview(view).cast("B")
+        total = len(prefix) + mv.nbytes
+        if total > 0xFFFFFFFF:
+            raise MpiError(
+                f"mpi_tpu: shm frame payload of {total} bytes "
+                f"exceeds the u32 wire limit")
+        tx = self._tx
+        if isinstance(tx, _NativeRing):
+            from .tcp import _view_cptr
+
+            ptr, keep = _view_cptr(mv)
+            try:
+                self._native_send(
+                    "send", lambda lib, ms: lib.shm_send_frame2(
+                        tx._h, kind, tag, prefix, len(prefix),
+                        ptr, mv.nbytes, ms))
+            finally:
+                del keep
+            return
+        deadline = self._deadline()
+        header = _FRAME_HDR.pack(kind, tag, total)
+        tx.write(memoryview(header), deadline)
+        if prefix:
+            tx.write(memoryview(prefix), deadline)
+        if mv.nbytes:
+            tx.write(mv, deadline)
 
     def recv_frame(self) -> Tuple[int, int, bytearray]:
         rx = self._rx

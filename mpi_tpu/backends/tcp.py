@@ -61,6 +61,7 @@ from .. import flags as flagmod
 from ..api import MpiError
 from ..utils.serialize import decode as codec_decode
 from ..utils.serialize import encode as codec_encode
+from ..utils.serialize import encode_parts as codec_encode_parts
 from .rendezvous import ReceiveCancelled, Rendezvous, TagManager
 from .shm import ShmConn
 
@@ -99,8 +100,30 @@ def _split_hostport(addr: str) -> Tuple[str, int]:
     return host, int(port)
 
 
+def _view_cptr(view):
+    """(c_void_p, keepalive) for a bytes-like without copying. The
+    caller must hold ``keepalive`` until the C call returns."""
+    import ctypes
+
+    if isinstance(view, bytes):
+        return ctypes.cast(ctypes.c_char_p(view), ctypes.c_void_p), view
+    mv = memoryview(view).cast("B")
+    if mv.readonly:
+        b = bytes(mv)  # rare (readonly ndarray): one copy, still sound
+        return ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p), b
+    arr = (ctypes.c_ubyte * mv.nbytes).from_buffer(mv)
+    return ctypes.cast(arr, ctypes.c_void_p), arr
+
+
 def _send_frame(sock, lock: threading.Lock, kind: int,
-                tag: int, payload: bytes = b"") -> None:
+                tag: int, payload: bytes = b"",
+                payload2=None) -> None:
+    """Write one wire frame. With ``payload2`` (the codec's
+    :func:`~mpi_tpu.utils.serialize.encode_parts` view) the frame body
+    is ``payload + payload2`` scatter-gathered straight from the
+    caller's buffer — the zero-copy ndarray data path; the receiver
+    sees one frame either way."""
+    n2 = 0 if payload2 is None else memoryview(payload2).nbytes
     if isinstance(sock, ShmConn):
         # shm conns frame in the ring engine; the per-conn lock still
         # serializes concurrent senders (the SPSC ring's one-producer
@@ -108,7 +131,10 @@ def _send_frame(sock, lock: threading.Lock, kind: int,
         if not isinstance(payload, bytes):
             payload = bytes(payload)
         with lock:
-            sock.send_frame(kind, tag, payload)
+            if payload2 is not None:
+                sock.send_frame2(kind, tag, payload, payload2)
+            else:
+                sock.send_frame(kind, tag, payload)
         return
     from .. import native as _native
 
@@ -118,29 +144,49 @@ def _send_frame(sock, lock: threading.Lock, kind: int,
     # u32 wire limit fall through so struct.pack rejects them loudly.
     lib = _native.wirecore() if sock.gettimeout() is None else None
     if lib is not None and isinstance(payload, bytes) \
-            and len(payload) <= 0xFFFFFFFF:
-        # Native path: header + payload leave in one writev — no
-        # user-space concatenation copy — with the GIL released for the
-        # whole syscall loop (ctypes CDLL semantics). -EINTR returns here
-        # so pending Python signal handlers (Ctrl+C) run between resumes.
+            and len(payload) + n2 <= 0xFFFFFFFF:
+        # Native path: header + payload (+ array view) leave in one
+        # writev — no user-space concatenation copy — with the GIL
+        # released for the whole syscall loop (ctypes CDLL semantics).
+        # -EINTR returns here so pending Python signal handlers
+        # (Ctrl+C) run between resumes.
         import ctypes
         import errno as _errno
         import os as _os
 
         progress = ctypes.c_uint64(0)
-        with lock:
-            while True:
-                rc = lib.wc_send_frame(sock.fileno(), kind, tag, payload,
-                                       len(payload),
-                                       ctypes.byref(progress))
-                if rc != -_errno.EINTR:
-                    break
+        if payload2 is not None:
+            ptr, keep = _view_cptr(payload2)
+            with lock:
+                while True:
+                    rc = lib.wc_send_frame2(
+                        sock.fileno(), kind, tag, payload, len(payload),
+                        ptr, n2, ctypes.byref(progress))
+                    if rc != -_errno.EINTR:
+                        break
+            del keep
+        else:
+            with lock:
+                while True:
+                    rc = lib.wc_send_frame(sock.fileno(), kind, tag,
+                                           payload, len(payload),
+                                           ctypes.byref(progress))
+                    if rc != -_errno.EINTR:
+                        break
         if rc == 0:
             return
         raise OSError(-rc, _os.strerror(-rc))
-    header = _FRAME_HDR.pack(kind, tag, len(payload))
+    header = _FRAME_HDR.pack(kind, tag, len(payload) + n2)
     with lock:
-        sock.sendall(header + payload)
+        if payload2 is not None:
+            # Two sendalls, zero concatenation: sendall accepts the
+            # (possibly readonly) view directly and loops partial
+            # writes itself. The lock spans both, so the frame stays
+            # contiguous on the stream.
+            sock.sendall(header + payload)
+            sock.sendall(payload2)
+        else:
+            sock.sendall(header + payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
@@ -306,19 +352,26 @@ class TcpNetwork:
         self._initialized = False
 
     def send(self, data: Any, dest: int, tag: int) -> None:
-        """Rendezvous send (network.go:518-572): encode, frame, block on ack."""
+        """Rendezvous send (network.go:518-572): encode, frame, block on ack.
+
+        Large contiguous arrays/bytes take the scatter-gather path
+        (``encode_parts``): the type prefix and the caller's buffer
+        leave as one frame with no tobytes/concat copy — measured ~2x
+        on 64 MiB one-way sends, where the two encode copies cost 81 ms
+        of a 155 ms transfer."""
         self._check_rank(dest)
-        payload = codec_encode(data)
         if dest == self._rank:
             # Self path: no tag manager involvement needed beyond the local
             # rendezvous's own misuse detection — and unlike the reference
             # we do not leak the tag (defect (a), SURVEY.md §2).
-            self._local.send(tag, payload)
+            self._local.send(tag, codec_encode(data))
             return
+        prefix, view = codec_encode_parts(data)
         peer = self._peers[dest]
         ackq, gen = peer.sendtags.claim(tag)
         try:
-            _send_frame(peer.dial_sock, peer.dial_lock, KIND_DATA, tag, payload)
+            _send_frame(peer.dial_sock, peer.dial_lock, KIND_DATA, tag,
+                        prefix, view)
             # Blocks until the receiver's ack (network.go:569).
             peer.sendtags.wait(ackq, gen)
         finally:

@@ -48,12 +48,18 @@ def _configure_wirecore(lib: ctypes.CDLL) -> None:
     lib.wc_send_frame.argtypes = [
         ctypes.c_int, ctypes.c_uint8, ctypes.c_int64,
         ctypes.c_char_p, ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint64)]
+    lib.wc_send_frame2.restype = ctypes.c_int
+    lib.wc_send_frame2.argtypes = [
+        ctypes.c_int, ctypes.c_uint8, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint64)]
     lib.wc_recv_exact.restype = ctypes.c_int
     lib.wc_recv_exact.argtypes = [
         ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_uint64)]
     lib.wc_version.restype = ctypes.c_int
-    if lib.wc_version() != 2:
+    if lib.wc_version() != 3:
         raise RuntimeError("wirecore version mismatch")
 
 
@@ -85,6 +91,11 @@ def _configure_shmcore(lib: ctypes.CDLL) -> None:
     lib.shm_send_frame.argtypes = [
         ctypes.c_void_p, ctypes.c_uint8, ctypes.c_int64,
         ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int]
+    lib.shm_send_frame2.restype = ctypes.c_int
+    lib.shm_send_frame2.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint8, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int]
     lib.shm_recv_hdr.restype = ctypes.c_int
     lib.shm_recv_hdr.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
@@ -96,7 +107,7 @@ def _configure_shmcore(lib: ctypes.CDLL) -> None:
     lib.shm_abandon.restype = ctypes.c_int
     lib.shm_abandon.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.shm_version.restype = ctypes.c_int
-    if lib.shm_version() != 1:
+    if lib.shm_version() != 2:
         raise RuntimeError("shmcore version mismatch")
 
 

@@ -35,6 +35,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 from .api import MpiError
@@ -193,10 +194,17 @@ def spawn(comm: Comm, command: str, args: Sequence[str] = (),
                     "--mpi-addr", waddr,
                     "--mpi-alladdr", ",".join(sorted(child_world)),
                     "--mpi-protocol", "tcp",
-                    "--mpi-password", password,
                     "--mpi-inittimeout", f"{max(1, round(timeout))}s"]
+            # The child-world password travels via env (flags.py
+            # resolves MPI_TPU_PASSWORD when the flag is absent), NOT
+            # argv: /proc/<pid>/cmdline is world-readable, and a
+            # secret there would let any local user join the child
+            # world's loopback ports. _FLAG_ENV stripping above
+            # removed any inherited value, so this set is the only
+            # one the child sees.
             procs.append(subprocess.Popen(
-                argv, env={**env, ENV_BRIDGE_ADDR: baddr}))
+                argv, env={**env, ENV_BRIDGE_ADDR: baddr,
+                           "MPI_TPU_PASSWORD": password}))
 
     # Every parent joins the bridge; init blocks until the children
     # connect (their get_parent side of this same all-to-all).
@@ -268,6 +276,17 @@ def disconnect(inter: Intercomm) -> None:
     inter.free()
     if net is not None:
         net.finalize()
+    # Reap the Popen children (root side): without a wait() each
+    # exited child lingers as a zombie until GC/interpreter exit, so a
+    # long-running master accumulates one per spawn — the exact leak
+    # this teardown exists to prevent. Disconnect is NOT child exit
+    # (MPI lets a disconnected child keep computing), so never block:
+    # poll() reaps the already-exited; a daemon waiter collects each
+    # straggler whenever it does exit.
+    for proc in getattr(inter, "_spawned_procs", ()):
+        if proc.poll() is None:
+            threading.Thread(target=proc.wait, daemon=True,
+                             name="mpi-tpu-spawn-reaper").start()
     with _parent_lock:
         if _parent_cache is inter:
             _parent_cache = None
@@ -536,21 +555,46 @@ def _join_bridge(comm: Comm, server_bridge: List[str],
 
 def _nameserver_dir() -> str:
     """Single-host registry directory (one file per service name).
-    Override with MPI_TPU_NAMESERVER_DIR; the default lives under the
-    system temp dir, created sticky/world-writable like /tmp itself so
-    independent users on one machine can each publish (lookups cross
-    users; unpublishing ANOTHER user's service does not — same
-    ownership rule as files in /tmp)."""
+
+    The default is PER-USER: ``$XDG_RUNTIME_DIR/mpi_tpu_nameserver``
+    (the runtime dir is 0700 by contract) or
+    ``<tmp>/mpi_tpu_nameserver-<uid>``, created 0700 and verified to
+    be owned by this uid. A fixed world-writable default would be
+    squattable — another local user pre-creates it (the old chmod
+    failure was tolerated) or replaces service-hash records, silently
+    redirecting a connecting client's rendezvous to a port they
+    control. Cross-user registries are therefore an EXPLICIT opt-in:
+    point MPI_TPU_NAMESERVER_DIR at a shared directory whose trust
+    the operator vouches for (that override is used as-is)."""
     import tempfile
 
-    d = os.environ.get("MPI_TPU_NAMESERVER_DIR") or os.path.join(
-        tempfile.gettempdir(), "mpi_tpu_nameserver")
-    if not os.path.isdir(d):
+    d = os.environ.get("MPI_TPU_NAMESERVER_DIR")
+    if d:
         os.makedirs(d, exist_ok=True)
+        return d
+    runtime = os.environ.get("XDG_RUNTIME_DIR")
+    if runtime and os.path.isdir(runtime):
+        d = os.path.join(runtime, "mpi_tpu_nameserver")
+    else:
+        d = os.path.join(tempfile.gettempdir(),
+                         f"mpi_tpu_nameserver-{os.getuid()}")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    st = os.lstat(d)
+    import stat as _stat
+
+    if not _stat.S_ISDIR(st.st_mode) or st.st_uid != os.getuid():
+        # Symlink swap or a squatter's pre-created dir: refuse loudly
+        # (the OpenSSH agent-dir rule) instead of publishing
+        # rendezvous addresses into a directory another user controls.
+        raise MpiError(
+            f"mpi_tpu: name-service dir {d!r} is not a directory "
+            f"owned by uid {os.getuid()} — refusing to use it; set "
+            f"MPI_TPU_NAMESERVER_DIR to a trusted location")
+    if st.st_mode & 0o077:
         try:
-            os.chmod(d, 0o1777)
+            os.chmod(d, 0o700)
         except OSError:
-            pass  # someone else's dir with their perms: usable as-is
+            pass  # ours but unfixable perms: records are still ours
     return d
 
 
@@ -577,8 +621,12 @@ def publish_name(service_name: str, port_name: str) -> None:
     # publishers, 'not found' to lookups).
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
+        # start: the publisher pid's kernel start time — lets
+        # _reclaim_if_stale tell a live publisher from an unrelated
+        # process that recycled the pid after a crash.
         _json.dump({"service": service_name, "port": port_name,
-                    "pid": os.getpid()}, f)
+                    "pid": os.getpid(),
+                    "start": _pid_start_time(os.getpid())}, f)
     try:
         for attempt in (0, 1):
             try:
@@ -595,11 +643,26 @@ def publish_name(service_name: str, port_name: str) -> None:
         os.unlink(tmp)
 
 
+def _pid_start_time(pid: int) -> Optional[int]:
+    """Kernel start time (clock ticks since boot) of ``pid``, or None
+    off-Linux / on read failure. Field 22 of /proc/<pid>/stat, parsed
+    after the last ')' so a comm containing spaces or parens cannot
+    shift the split."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            raw = f.read().decode("ascii", "replace")
+        return int(raw.rsplit(")", 1)[1].split()[19])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
 def _reclaim_if_stale(path: str) -> bool:
     """True when ``path`` held a publisher that no longer exists and
     was removed — a server that crashed without unpublishing must not
     wedge its service name forever (its restart is the normal caller
-    here). Liveness = the recorded pid still exists on this host.
+    here). Liveness = the recorded pid still exists on this host AND
+    (when recorded) its kernel start time matches — a recycled pid
+    does not keep a dead publisher's name alive.
 
     An exclusive reclaim lock serializes concurrent reclaimers: a
     read-then-remove without it could delete a RIVAL's freshly linked
@@ -607,21 +670,45 @@ def _reclaim_if_stale(path: str) -> bool:
     and let two publishes both 'succeed'. Losers simply report
     already-published; inside the lock the only concurrent writers
     are unpublish (remove -> our remove just misses) and publish
-    (link-only — cannot replace the file we judged)."""
+    (link-only — cannot replace the file we judged).
+
+    The lock is ``flock``-based, NOT existence-based: the kernel
+    releases an flock when its holder dies, so a reclaimer killed
+    between acquire and release cannot orphan the lock and wedge the
+    name (the O_EXCL design's failure mode, ADVICE r4), and breaking
+    a stale lock needs no TTL heuristics or unlink-by-path races.
+    The fstat/stat inode check closes the classic flock+unlink race:
+    a lock acquired on an inode that a finishing rival already
+    unlinked is discarded and the open retried."""
+    import fcntl
     import json as _json
 
     lock = f"{path}.reclaim"
-    try:
-        fd = os.open(lock, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
-    except FileExistsError:
-        return False   # another reclaimer owns the verdict
-    except OSError:
+    fd = None
+    for _ in range(8):  # bounded: pathological churn -> report False
+        try:
+            cand = os.open(lock, os.O_WRONLY | os.O_CREAT, 0o644)
+        except OSError:
+            return False
+        try:
+            fcntl.flock(cand, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(cand)
+            return False   # a LIVE reclaimer owns the verdict
+        try:
+            if os.fstat(cand).st_ino == os.stat(lock).st_ino:
+                fd = cand
+                break
+        except OSError:
+            pass           # path vanished under us: retry the open
+        os.close(cand)     # locked a rival's unlinked inode: retry
+    if fd is None:
         return False
-    os.close(fd)
     try:
         try:
             with open(path) as f:
-                pid = int(_json.load(f)["pid"])
+                rec = _json.load(f)
+                pid = int(rec["pid"])
         except (OSError, ValueError, KeyError, TypeError):
             # Unreadable/half-gone: a VANISHED file counts as
             # reclaimed (the owner just unpublished); anything else
@@ -629,21 +716,38 @@ def _reclaim_if_stale(path: str) -> bool:
             return not os.path.exists(path)
         try:
             os.kill(pid, 0)
-            return False          # publisher alive
+            alive = True
         except ProcessLookupError:
-            pass                  # dead: reclaim below
+            alive = False         # dead: reclaim below
         except PermissionError:
-            return False          # alive, other user
+            alive = True          # exists, owned by another user
+        if alive:
+            # /proc start time is readable regardless of uid, so the
+            # recycled-pid check runs for the PermissionError case
+            # too — pids are host-global, and a crashed publisher's
+            # pid recycled by ANOTHER user's daemon must not wedge
+            # the name forever.
+            rec_start = rec.get("start")
+            cur_start = _pid_start_time(pid)
+            if (rec_start is None or cur_start is None
+                    or cur_start == rec_start):
+                return False      # genuinely the live publisher
+            # pid exists but is a DIFFERENT process: reclaim below.
         try:
             os.remove(path)
             return True
         except OSError:
             return False
     finally:
+        # Unlink BEFORE close: close releases the flock, and a rival
+        # must never acquire an flock on an inode that is still the
+        # live path (the inode-identity loop above assumes unlinked
+        # means released).
         try:
             os.unlink(lock)
         except OSError:
             pass
+        os.close(fd)
 
 
 def unpublish_name(service_name: str, port_name: Optional[str] = None

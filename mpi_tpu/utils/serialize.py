@@ -38,7 +38,12 @@ from typing import Any, Optional
 
 import numpy as np
 
-__all__ = ["Raw", "encode", "decode", "CodecError"]
+__all__ = ["Raw", "encode", "encode_parts", "decode", "CodecError"]
+
+# Below this, the two-part path's extra bookkeeping outweighs the copy
+# it saves; above it, skipping tobytes() + join is a measured ~2x on
+# the 64 MiB one-way send (encode was 81 ms of a 155 ms transfer).
+PARTS_MIN_BYTES = 32 << 10
 
 KIND_RAW = 0
 KIND_NDARRAY = 1
@@ -97,6 +102,40 @@ def encode(data: Any) -> bytes:
         return bytes([KIND_PICKLE]) + pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
     except Exception as exc:  # pragma: no cover - exotic unpicklables
         raise CodecError(f"cannot encode {type(data)!r}: {exc}") from exc
+
+
+def encode_parts(data: Any):
+    """``(prefix, view)`` — the zero-copy form of :func:`encode`.
+
+    For large C-contiguous ndarrays (and bytes-likes) the wire bytes
+    are ``prefix + view`` with ``view`` aliasing the caller's buffer:
+    no ``tobytes()``, no join — the transport scatter-gathers both
+    segments into one frame (wc_send_frame2 / shm_send_frame2 /
+    sendmsg). Every other payload returns ``(encode(data), None)``.
+    ``prefix + bytes(view)`` is byte-identical to ``encode(data)`` —
+    the receiver cannot tell which form the sender used. The caller
+    must not mutate ``data`` until the send completes (the same
+    aliasing contract Raw's decode reuse documents)."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        mv = memoryview(data)
+        # cast("B") demands C-contiguity specifically — an
+        # F-contiguous view would raise where encode() succeeds.
+        if mv.nbytes >= PARTS_MIN_BYTES and mv.c_contiguous:
+            return bytes([KIND_RAW]), mv.cast("B")
+        return encode(data), None
+    arr = data
+    if _is_jax_array(arr):
+        arr = np.asarray(arr)
+    if (isinstance(arr, np.ndarray)
+            and arr.flags.c_contiguous
+            and arr.nbytes >= PARTS_MIN_BYTES
+            and not arr.dtype.hasobject and arr.dtype.kind != "V"):
+        dt = arr.dtype.str.encode("ascii")
+        if len(dt) <= 255 and arr.ndim <= 255:
+            header = struct.pack(f"<B{arr.ndim}I", arr.ndim, *arr.shape)
+            prefix = bytes([KIND_NDARRAY, len(dt)]) + dt + header
+            return prefix, memoryview(arr).cast("B")
+    return encode(data), None
 
 
 def decode(payload: bytes, out: Optional[Any] = None) -> Any:

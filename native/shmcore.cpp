@@ -383,6 +383,46 @@ int shm_send_frame(void *handle, uint8_t kind, int64_t tag,
   return 0;
 }
 
+// Two-segment frame send: header + prefix + payload streamed through
+// the ring as ONE frame of length prefix_len + payload_len — the
+// zero-copy path for ndarray sends (the codec's type prefix and the
+// array memory are never concatenated in user space). Resumable after
+// -EINTR via h->op_done, which spans header + both segments.
+int shm_send_frame2(void *handle, uint8_t kind, int64_t tag,
+                    const uint8_t *prefix, uint32_t prefix_len,
+                    const uint8_t *payload, uint32_t payload_len,
+                    int timeout_ms) {
+  Handle *h = static_cast<Handle *>(handle);
+  if (h->poisoned) return -EPIPE;
+  const uint64_t length64 =
+      static_cast<uint64_t>(prefix_len) + payload_len;
+  if (length64 > 0xFFFFFFFFull) return -EMSGSIZE;
+  const uint32_t length = static_cast<uint32_t>(length64);
+  if (h->op_done == 0) {
+    h->frame_hdr[0] = kind;
+    std::memcpy(h->frame_hdr + 1, &tag, 8);
+    std::memcpy(h->frame_hdr + 9, &length, 4);
+  }
+  if (h->op_done < kFrameHdrLen) {
+    int rc = ring_write(h, h->frame_hdr, kFrameHdrLen, timeout_ms,
+                        &h->op_done);
+    if (rc != 0) return poison_if_midframe(h, rc);
+  }
+  uint64_t done = h->op_done - kFrameHdrLen;
+  if (done < prefix_len) {
+    int rc = ring_write(h, prefix, prefix_len, timeout_ms, &done);
+    h->op_done = kFrameHdrLen + done;
+    if (rc != 0) return poison_if_midframe(h, rc);
+  }
+  uint64_t payload_done = h->op_done - kFrameHdrLen - prefix_len;
+  int rc = ring_write(h, payload, payload_len, timeout_ms,
+                      &payload_done);
+  h->op_done = kFrameHdrLen + prefix_len + payload_done;
+  if (rc != 0) return poison_if_midframe(h, rc);
+  h->op_done = 0;
+  return 0;
+}
+
 // Phase 1 of a receive: the 13-byte frame header. Resumable after
 // -EINTR. On success the parsed fields are returned and the handle is
 // ready for shm_recv_payload (which must consume exactly *length).
@@ -424,6 +464,6 @@ int shm_abandon(void *handle, int force) {
   return h->poisoned ? 1 : 0;
 }
 
-int shm_version() { return 1; }
+int shm_version() { return 2; }
 
 }  // extern "C"

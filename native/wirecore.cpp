@@ -76,6 +76,57 @@ int wc_send_frame(int fd, uint8_t kind, int64_t tag, const uint8_t *payload,
   return 0;
 }
 
+// Two-segment frame send: header + prefix + payload in ONE writev.
+// The zero-copy data path for ndarray sends — the codec's type prefix
+// (kind, dtype, shape) and the array's own memory leave without ever
+// being concatenated; the wire sees one frame of length
+// prefix_len + payload_len, indistinguishable from wc_send_frame's.
+// *progress counts total frame bytes written; resume after -EINTR.
+int wc_send_frame2(int fd, uint8_t kind, int64_t tag,
+                   const uint8_t *prefix, uint32_t prefix_len,
+                   const uint8_t *payload, uint32_t payload_len,
+                   uint64_t *progress) {
+  const uint64_t length64 =
+      static_cast<uint64_t>(prefix_len) + payload_len;
+  if (length64 > 0xFFFFFFFFull) return -EMSGSIZE;
+  const uint32_t length = static_cast<uint32_t>(length64);
+  uint8_t header[kHeaderLen];
+  header[0] = kind;
+  std::memcpy(header + 1, &tag, 8);
+  std::memcpy(header + 9, &length, 4);
+  const uint64_t total = kHeaderLen + length64;
+  while (*progress < total) {
+    uint64_t done = *progress;
+    iovec iov[3];
+    int iovcnt = 0;
+    if (done < kHeaderLen) {
+      iov[iovcnt].iov_base = header + done;
+      iov[iovcnt].iov_len = kHeaderLen - done;
+      ++iovcnt;
+      done = 0;
+    } else {
+      done -= kHeaderLen;
+    }
+    if (prefix_len > done) {
+      iov[iovcnt].iov_base = const_cast<uint8_t *>(prefix + done);
+      iov[iovcnt].iov_len = prefix_len - done;
+      ++iovcnt;
+      done = 0;
+    } else {
+      done -= prefix_len;
+    }
+    if (payload_len > done) {
+      iov[iovcnt].iov_base = const_cast<uint8_t *>(payload + done);
+      iov[iovcnt].iov_len = payload_len - done;
+      ++iovcnt;
+    }
+    ssize_t n = ::writev(fd, iov, iovcnt);
+    if (n < 0) return -errno;  // -EINTR resumes from *progress
+    *progress += static_cast<uint64_t>(n);
+  }
+  return 0;
+}
+
 // Receive exactly n bytes into buf. *progress counts bytes already read;
 // start with 0 and re-invoke unchanged after -EINTR.
 int wc_recv_exact(int fd, uint8_t *buf, uint64_t n, uint64_t *progress) {
@@ -89,6 +140,6 @@ int wc_recv_exact(int fd, uint8_t *buf, uint64_t n, uint64_t *progress) {
 }
 
 // Sanity probe for the loader.
-int wc_version() { return 2; }
+int wc_version() { return 3; }
 
 }  // extern "C"

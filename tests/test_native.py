@@ -17,7 +17,7 @@ requires_native = pytest.mark.skipif(
 @requires_native
 def test_native_builds_and_loads():
     lib = native.wirecore()
-    assert lib.wc_version() == 2
+    assert lib.wc_version() == 3
 
 
 def _roundtrip(payload: bytes, tag: int = 42, kind: int = 0):

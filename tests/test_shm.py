@@ -108,6 +108,45 @@ class TestRing:
                 attached.close()
             unlink_ring(name)
 
+    def test_send_frame2_roundtrip(self, ring_mode):
+        # The codec's scatter-gather path (encode_parts): prefix +
+        # array view stream as ONE frame, byte-identical on the wire
+        # to the single-buffer form — including resumed streaming when
+        # the frame is larger than the ring.
+        import numpy as np
+
+        name = f"/mpitpu-test-{uuid.uuid4().hex[:10]}"
+        creator = create_ring(name, 1 << 12)
+        attached = attach_ring(name)
+        try:
+            conn = ShmConn(creator, attached)
+            arr = np.random.default_rng(7).standard_normal(
+                (1 << 14)).astype(np.float32)   # 16x the ring
+            from mpi_tpu.utils import serialize as S
+
+            prefix, view = S.encode_parts(arr)
+            assert view is not None
+            got = {}
+
+            def reader():
+                got["frame"] = conn.recv_frame()
+
+            t = threading.Thread(target=reader)
+            t.start()
+            conn.send_frame2(5, 99, prefix, view)
+            t.join(20)
+            kind, tag, payload = got["frame"]
+            assert (kind, tag) == (5, 99)
+            assert bytes(payload) == S.encode(arr)
+            back = S.decode(payload)
+            np.testing.assert_array_equal(back, arr)
+        finally:
+            creator.mark_closed()
+            creator.close()
+            if attached is not None:
+                attached.close()
+            unlink_ring(name)
+
     def test_payload_larger_than_ring_streams(self, ring_mode):
         # Capacity bounds memory, not message size: a payload 8x the
         # ring streams through while the reader drains.

@@ -317,6 +317,92 @@ class TestNameService:
         else:
             raise AssertionError("live duplicate publish accepted")
 
+    def test_orphaned_reclaim_lock_is_broken(self, tmp_path,
+                                             monkeypatch):
+        """A reclaimer killed mid-verdict must not wedge the service
+        name (ADVICE r4: the exact failure mode the reclaim path
+        exists to fix). The lock is flock-based — the kernel releases
+        it with its holder, so a leftover lock FILE (dead holder) is
+        acquirable immediately, while a lock HELD by a live process
+        is honored."""
+        import fcntl
+        import json
+        import os
+
+        from mpi_tpu import spawn as _spawn
+
+        monkeypatch.setenv("MPI_TPU_NAMESERVER_DIR", str(tmp_path))
+        _spawn.publish_name("kraken", "h:1")
+        path = _spawn._service_path("kraken")
+        with open(path, "w") as f:   # forge a dead publisher
+            json.dump({"service": "kraken", "port": "h:1",
+                       "pid": 2 ** 30}, f)
+        lock = f"{path}.reclaim"
+        # A LIVE reclaimer (flock held): publish must report
+        # already-published, not steal the verdict.
+        holder = os.open(lock, os.O_WRONLY | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            with pytest.raises(api.MpiError, match="already published"):
+                _spawn.publish_name("kraken", "h:2")
+        finally:
+            os.close(holder)         # "holder dies": kernel releases
+        # The lock file is still on disk, but nobody holds the flock —
+        # a dead reclaimer's leftover must not block the reclaim.
+        with open(lock, "w"):
+            pass
+        _spawn.publish_name("kraken", "h:2")
+        assert _spawn.lookup_name("kraken") == "h:2"
+        assert not os.path.exists(lock)
+
+    def test_recycled_pid_does_not_block_reclaim(self, tmp_path,
+                                                 monkeypatch):
+        """A record whose pid exists but whose recorded start time
+        belongs to a DIFFERENT (dead) process must be reclaimable —
+        pid reuse must not keep a crashed publisher's name wedged."""
+        import json
+        import os
+
+        from mpi_tpu import spawn as _spawn
+
+        monkeypatch.setenv("MPI_TPU_NAMESERVER_DIR", str(tmp_path))
+        _spawn.publish_name("hydra", "h:1")
+        path = _spawn._service_path("hydra")
+        with open(path, "w") as f:
+            # Live pid (ours), but a start time that cannot match.
+            json.dump({"service": "hydra", "port": "h:1",
+                       "pid": os.getpid(), "start": -1}, f)
+        _spawn.publish_name("hydra", "h:2")   # reclaims, no raise
+        assert _spawn.lookup_name("hydra") == "h:2"
+
+    def test_default_registry_dir_is_per_user_private(self, tmp_path,
+                                                      monkeypatch):
+        """With no override, the registry defaults to a per-user 0700
+        directory (ADVICE r4: a fixed world-writable default is
+        squattable), and a symlinked default is refused loudly."""
+        import os
+        import stat
+
+        from mpi_tpu import spawn as _spawn
+
+        runtime = tmp_path / "runtime"
+        runtime.mkdir()
+        monkeypatch.delenv("MPI_TPU_NAMESERVER_DIR", raising=False)
+        monkeypatch.setenv("XDG_RUNTIME_DIR", str(runtime))
+        d = _spawn._nameserver_dir()
+        assert d == str(runtime / "mpi_tpu_nameserver")
+        st = os.lstat(d)
+        assert st.st_uid == os.getuid()
+        assert not (st.st_mode & 0o077), oct(st.st_mode)
+        # Symlink swap at the default path: refused, never used.
+        os.rmdir(d)
+        target = tmp_path / "elsewhere"
+        target.mkdir()
+        os.symlink(target, d)
+        with pytest.raises(api.MpiError, match="refusing"):
+            _spawn._nameserver_dir()
+        assert stat.S_ISLNK(os.lstat(d).st_mode)
+
     def test_lookup_timeout_covers_publish_race(self, tmp_path,
                                                 monkeypatch):
         """A client may look up before its server publishes; the

@@ -203,6 +203,25 @@ class TestSendReceive:
 
         run_on_ranks(cluster4, body, timeout=60)
 
+    def test_large_ndarray_scatter_gather(self, cluster4):
+        # >= PARTS_MIN_BYTES contiguous arrays take the encode_parts
+        # zero-copy frame (prefix + view via writev); the receiver
+        # must get an identical typed round-trip.
+        big = np.random.default_rng(3).standard_normal(
+            (512, 1024)).astype(np.float32)          # 2 MiB, 2-D
+
+        def body(net, r):
+            if r == 0:
+                net.send(big, dest=1, tag=11)
+                net.send(big[::2], dest=1, tag=12)   # non-contiguous
+            elif r == 1:
+                got = net.receive(0, tag=11)
+                np.testing.assert_array_equal(got, big)
+                got2 = net.receive(0, tag=12)
+                np.testing.assert_array_equal(got2, big[::2])
+
+        run_on_ranks(cluster4, body, timeout=60)
+
     def test_receive_out_buffer(self, cluster4):
         src_arr = np.arange(64, dtype=np.float32)
 
