@@ -14,6 +14,7 @@ Run::
     python -m mpi_tpu.launch.mpirun 4 examples/mpi4py_port.py
 """
 
+import math
 import os
 import sys
 import tempfile
@@ -140,6 +141,36 @@ if rank == 0:
     assert sorted([first] + rest) == list(range(1, size))
 else:
     comm.send(rank, dest=0, tag=31)
+
+# -------------------- 9. error classes + external32 + Grequest (MPI-tail)
+
+# MPI.Exception carries the error-class protocol: programmatic error
+# handling by MPI_ERR_* code, exactly as mpi4py spells it.
+try:
+    comm.send(b"x", dest=size + 7, tag=0)
+except MPI.Exception as exc:
+    assert exc.Get_error_class() == MPI.ERR_RANK
+    assert MPI.Get_error_string(MPI.ERR_RANK) == "MPI_ERR_RANK"
+
+# Portable external32 pack: canonical big-endian bytes, so a buffer
+# packed on any platform unpacks on any other.
+packbuf = np.zeros(MPI.DOUBLE.Pack_external_size("external32", 2),
+                   np.uint8)
+end = MPI.DOUBLE.Pack_external(
+    "external32", np.array([math.pi, math.e]), packbuf, 0)
+back = np.zeros(2, np.float64)
+assert MPI.DOUBLE.Unpack_external("external32", packbuf, 0, back) == end
+assert back[0] == math.pi and back[1] == math.e
+
+# A generalized request completes when USER code says so, and mixes
+# with ordinary requests in the set operations.
+greq = MPI.Grequest.Start()
+peer = (rank + 1) % size
+reqs = [greq, comm.isend(rank, dest=peer, tag=41),
+        comm.irecv(source=(rank - 1) % size, tag=41)]
+greq.Complete()
+got = MPI.Request.waitall(reqs)
+assert got[2] == (rank - 1) % size
 
 print(f"rank {rank}/{size}: pi={pi:.6f} ticket={int(ticket[0])} "
       f"coords={cart.coords} — mpi4py surface OK")

@@ -105,7 +105,26 @@ __all__ = [
 
 
 class MpiError(RuntimeError):
-    """Base class for all framework errors."""
+    """Base class for all framework errors.
+
+    Carries the mpi4py ``MPI.Exception`` error-class protocol: code
+    written against ``exc.Get_error_class() == MPI.ERR_RANK`` works
+    unchanged (classes derive from the exception's type and message —
+    :mod:`mpi_tpu.errclass`)."""
+
+    def Get_error_class(self) -> int:
+        from . import errclass
+
+        return errclass.classify(self)
+
+    def Get_error_code(self) -> int:
+        # No implementation-specific codes beyond the classes here.
+        return self.Get_error_class()
+
+    def Get_error_string(self) -> str:
+        from . import errclass
+
+        return errclass.error_string(self.Get_error_class())
 
 
 class TagError(MpiError):
@@ -746,9 +765,11 @@ class Request:
     ``{peer, tag}`` pair is free for reuse — exactly the contract the
     sketch specifies."""
 
-    def __init__(self, fn):
+    def __init__(self, fn, cancel_hook=None):
         self._result: Any = None
         self._exc: Optional[BaseException] = None
+        self._cancel_hook = cancel_hook
+        self._cancelled = False
 
         def run():
             try:
@@ -764,16 +785,65 @@ class Request:
         Completion includes failure — ``wait`` reports which."""
         return not self._thread.is_alive()
 
+    def cancel(self) -> bool:
+        """MPI_Cancel: best-effort cancellation of a pending operation.
+
+        True when the operation was actually cancelled (a receive whose
+        message had not yet been matched); the canonical completion
+        sequence is still ``cancel(); wait()`` — after a successful
+        cancel, ``wait`` returns ``None`` and :attr:`cancelled` is
+        True, rather than raising (MPI's cancelled-request contract).
+        A request with nothing cancellable (sends mid-rendezvous, an
+        already-matched receive, collectives) returns False and
+        completes normally — MPI says cancellation is permitted to
+        fail.
+
+        The retract hook only bites once the worker thread has CLAIMED
+        the tag — a cancel racing a just-posted irecv would no-op and
+        leave ``wait()`` blocked forever — so this retries over a
+        short bounded window until the claim exists (normally
+        microseconds away) or the operation completes by itself."""
+        if self._cancel_hook is None:
+            return False
+        deadline = time.monotonic() + 1.0
+        while not self.test():
+            try:
+                hit = self._cancel_hook()
+            except Exception:
+                return False  # invalid envelope etc: wait() reports it
+            if hit:
+                self._cancelled = True
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)
+        return False
+
+    @property
+    def cancelled(self) -> bool:
+        """True when :meth:`cancel` succeeded (MPI_Test_cancelled)."""
+        return self._cancelled
+
     def wait(self, timeout: Optional[float] = None) -> Any:
         """Block until completion; return the received payload (None for
         sends). Raises the operation's error, or ``MpiError`` on
-        timeout."""
+        timeout. A successfully cancelled request completes with
+        ``None`` instead of raising (check :attr:`cancelled`)."""
         self._thread.join(timeout)
         if self._thread.is_alive():
             raise MpiError(
                 f"mpi_tpu: Request.wait timed out after {timeout}s")
         if self._exc is not None:
+            from .backends.rendezvous import ReceiveCancelled
+
+            if self._cancelled and isinstance(self._exc,
+                                              ReceiveCancelled):
+                return None  # cancelled completion, per MPI semantics
             raise self._exc
+        # The payload arrived despite a racing cancel (MPI: a
+        # successful cancel means NO part of the message was received
+        # — so a delivered message proves the cancel did not happen).
+        self._cancelled = False
         return self._result
 
 
@@ -790,9 +860,15 @@ def isend(data: Any, dest: int, tag: int) -> Request:
 
 
 def irecv(source: int, tag: int, out: Optional[Any] = None) -> Request:
-    """Nonblocking receive: ``wait()`` returns the payload."""
+    """Nonblocking receive: ``wait()`` returns the payload. Supports
+    ``Request.cancel()`` when the backend can retract an unmatched
+    receive (``cancel_receive`` — the tcp/shm and xla drivers can)."""
     _require_init()
-    return Request(lambda: receive(source, tag, out))
+    impl = registered()
+    hook = getattr(impl, "cancel_receive", None)
+    return Request(lambda: receive(source, tag, out),
+                   cancel_hook=(None if hook is None
+                                else lambda: hook(source, tag)))
 
 
 def waitall(requests: List[Optional[Request]],

@@ -494,14 +494,16 @@ class _HybridGroupEngine:
     # (~7 ms leader-side; followers wait out both, ~21 ms) fully
     # serialized on the critical path, and on a real
     # multi-host fabric they use different resources (NIC vs local
-    # memory), so overlap should approach max() of the tiers. On the
-    # one-core loopback box the A/B is 0.91x quiet / 0.45x-1.25x loaded
-    # across runs, bench keys hybrid_allreduce_8MiB_*), so the gate
-    # ships CLOSED — same discipline as quantized_eligible: the
-    # default path must never lose at any measured size on the
-    # measured fabric. Enable on a real deployment with
-    # MPI_TPU_HYBRID_PIPELINE_MIN=<bytes> (4 MiB is the design point)
-    # after its own A/B.
+    # memory), so overlap should approach max() of the tiers.
+    #
+    # EXPERIMENTAL, DCN-ONLY (round-5 verdict #4 resolution): the
+    # gate ships CLOSED and this lever must not be enabled on any
+    # fabric without winning its own A/B there. The definitive
+    # loopback measurement (16/64 MiB, 4+8 chunks, interleaved
+    # variants on the zero-copy wire path — docs/PERF_NOTES.md) shows
+    # 0.83x-1.05x, inside the serial leg's rerun spread: one core has
+    # nothing to overlap. Enable on a real multi-host deployment with
+    # MPI_TPU_HYBRID_PIPELINE_MIN=<bytes> after an on-fabric A/B.
     _PIPELINE_CHUNKS = 4
 
     @staticmethod

@@ -25,6 +25,7 @@ so collective traffic can never collide with user point-to-point tags).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Callable, List, Optional, Union
 
@@ -202,16 +203,45 @@ def bcast(impl: Interface, data: Any, root: int = 0,
     return payload
 
 
-# Large numeric payloads switch from the binomial tree to the
-# bandwidth-optimal ring (the same size-based algorithm selection
-# MPICH/OpenMPI apply). Below the threshold the tree's fewer rounds
-# win — each ring hop pays a full rendezvous handshake, and loopback
-# bandwidth is nearly free — above it the ring's 2(n-1)/n buffer
-# movement beats the tree's log2(n) full-buffer hops. Measured on the
-# loopback TCP driver (the environment this layer actually serves):
-# 1 MiB/8 ranks ring = 0.29x tree, 16 MiB = 0.83x, 64 MiB = 2.23x —
-# crossover between 16 and 64 MiB, so 32 MiB.
-RING_MIN_BYTES = 32 << 20
+# Large numeric payloads CAN switch from the binomial tree to the
+# bandwidth-optimal ring (the size-based algorithm selection
+# MPICH/OpenMPI apply) — but the switch is a measured gate, and on
+# every fabric this layer has been measured on, the ring loses:
+#
+# * Pre round 5 (copy-heavy wire path) the crossover measured 32 MiB
+#   (ring 2.23x tree at 64 MiB / 8 ranks) and that was the default.
+# * Round 5's zero-copy send path (encode_parts + writev) cut
+#   per-byte cost ~2.5x, which helps the tree's full-buffer hops
+#   most: remeasured on loopback TCP, tree wins at EVERY size
+#   (4 ranks: 64 MiB ring 950 ms vs tree 455 ms; 256 MiB ring
+#   39.8 s vs tree 7.4 s; 8 ranks the same shape). On a shared-core
+#   loopback fabric the ring's 2(n-1) strictly sequential rounds —
+#   each a full rendezvous — dominate its per-byte advantage.
+#
+# So the default is NEVER (same never-lose discipline as
+# QUANTIZED_MIN_BYTES). On a real multi-host fabric, where each ring
+# hop rides its own link concurrently and bandwidth genuinely
+# dominates, set MPI_TPU_RING_MIN_BYTES to the measured crossover;
+# every driver reads the same constant, so the cross-driver bitwise
+# contract (identical algorithm per payload) holds at any setting.
+# NB: every rank must see the SAME value (export it uniformly —
+# launchers propagate the environment; a per-host divergence would
+# have ranks disagree on the algorithm and hang), and a malformed
+# value is a LOUD no-op: silently ignoring it would defeat the
+# explicit opt-in.
+_RING_MIN_NEVER = 1 << 62
+try:
+    RING_MIN_BYTES = int(os.environ.get("MPI_TPU_RING_MIN_BYTES",
+                                        str(_RING_MIN_NEVER)))
+except ValueError:
+    import warnings
+
+    warnings.warn(
+        f"mpi_tpu: MPI_TPU_RING_MIN_BYTES="
+        f"{os.environ['MPI_TPU_RING_MIN_BYTES']!r} is not an integer "
+        f"byte count — ring dispatch stays OFF",
+        RuntimeWarning, stacklevel=1)
+    RING_MIN_BYTES = _RING_MIN_NEVER
 
 
 def _ring_dtype_ok(dtype) -> bool:

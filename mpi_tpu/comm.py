@@ -331,8 +331,20 @@ class Comm:
 
     def irecv(self, source: int, tag: int, out: Optional[Any] = None
               ) -> Request:
-        """Nonblocking group receive; ``wait()`` returns the payload."""
-        return Request(lambda: self.receive(source, tag, out=out))
+        """Nonblocking group receive; ``wait()`` returns the payload.
+        Cancellable while unmatched (``Request.cancel()``) — the hook
+        retracts the claim under the same member/context-tag mapping
+        the receive itself uses."""
+        hook = None
+        if getattr(self._impl, "cancel_receive", None) is not None \
+                and source is not None:
+            # Lazy: validation/mapping happen inside cancel_receive AT
+            # CANCEL TIME, so an invalid source/tag surfaces at wait()
+            # on every driver alike (eager mapping here would make the
+            # error site depend on whether the backend is cancellable).
+            hook = lambda: self.cancel_receive(source, tag)  # noqa: E731
+        return Request(lambda: self.receive(source, tag, out=out),
+                       cancel_hook=hook)
 
     def receive_any(self, tag: int, timeout: Optional[float] = None
                     ) -> Tuple[int, Any]:

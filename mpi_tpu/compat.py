@@ -85,6 +85,7 @@ from typing import Any, List, Optional
 import numpy as np
 
 from . import api
+from . import errclass as _errclass
 from .comm import Comm as _NativeComm, comm_self, comm_world
 
 __all__ = ["MPI"]
@@ -99,6 +100,15 @@ class Status:
         self.source: int = -1
         self.tag: int = -1
         self.count: int = -1   # elements (arrays) / bytes (raw) / -1
+        self.cancelled: bool = False
+
+    def Is_cancelled(self) -> bool:
+        """True when the request this status completed was
+        successfully cancelled (MPI_Test_cancelled)."""
+        return self.cancelled
+
+    def Set_cancelled(self, flag: bool) -> None:
+        self.cancelled = bool(flag)
 
     def Get_source(self) -> int:
         return self.source
@@ -144,7 +154,11 @@ class Request:
         self._inner = inner
 
     def wait(self, status: Optional[Status] = None) -> Any:
-        return self._inner.wait()
+        result = self._inner.wait()
+        if status is not None:
+            status.Set_cancelled(getattr(self._inner, "cancelled",
+                                         False))
+        return result
 
     Wait = wait
 
@@ -152,6 +166,15 @@ class Request:
         return self._inner.test()
 
     Test = test
+
+    def Cancel(self) -> None:
+        """MPI_Cancel: best-effort — a receive whose message has not
+        been matched is retracted (its ``Wait`` then completes with
+        ``None`` and ``status.Is_cancelled()`` True); anything else
+        completes normally, as MPI permits."""
+        cancel = getattr(self._inner, "cancel", None)
+        if cancel is not None:
+            cancel()
 
     @classmethod
     def Waitall(cls, requests: List["Request"]) -> List[Any]:
@@ -344,6 +367,81 @@ class Prequest(Request):
         return not self._p.active
 
     test = Test
+
+
+class _GrequestInner:
+    """Event-backed stand-in for :class:`api.Request`: completion is
+    the user's :meth:`Grequest.Complete` call, not a worker thread —
+    shaped like the native request so the Waitall/Waitany set
+    operations mix Grequests with ordinary requests."""
+
+    def __init__(self) -> None:
+        self._ev = _threading.Event()
+        self.cancelled = False
+
+    def test(self) -> bool:
+        return self._ev.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        if not self._ev.wait(timeout):
+            raise api.MpiError(
+                f"mpi_tpu.compat: Grequest.wait timed out after "
+                f"{timeout}s (Complete() never called)")
+        return None
+
+    def cancel(self) -> bool:
+        return False  # Grequest cancellation is the cancel_fn's job
+
+
+class Grequest(Request):
+    """mpi4py ``MPI.Grequest`` — generalized requests: user-defined
+    operations that complete when the USER calls :meth:`Complete`,
+    integrating with the whole request machinery (Wait/Test/Waitall).
+
+    Callback contract (MPI_Grequest_start): ``query_fn(status,
+    *args)`` fills the status at completion-query time; ``free_fn
+    (*args)`` runs at :meth:`Free`; ``cancel_fn(completed, *args)``
+    runs at :meth:`Cancel` with whether the operation had already
+    completed. Callbacks may be None."""
+
+    def __init__(self, query_fn=None, free_fn=None, cancel_fn=None,
+                 args: tuple = ()):
+        super().__init__(_GrequestInner())
+        self._query_fn = query_fn
+        self._free_fn = free_fn
+        self._cancel_fn = cancel_fn
+        self._args = tuple(args or ())
+
+    @classmethod
+    def Start(cls, query_fn=None, free_fn=None, cancel_fn=None,
+              args=None) -> "Grequest":
+        return cls(query_fn, free_fn, cancel_fn, args or ())
+
+    def Complete(self) -> None:
+        """Mark the operation complete: pending/future ``Wait``s
+        return (MPI_Grequest_complete)."""
+        self._inner._ev.set()
+
+    def wait(self, status: Optional[Status] = None) -> Any:
+        result = self._inner.wait()
+        if status is not None:
+            if self._query_fn is not None:
+                self._query_fn(status, *self._args)
+            status.Set_cancelled(self._inner.cancelled)
+        return result
+
+    Wait = wait
+
+    def Cancel(self) -> None:
+        if self._cancel_fn is not None:
+            self._cancel_fn(self._inner.test(), *self._args)
+        if not self._inner.test():
+            self._inner.cancelled = True
+            self.Complete()  # a cancelled grequest completes, per MPI
+
+    def Free(self) -> None:
+        if self._free_fn is not None:
+            self._free_fn(*self._args)
 
 
 class _FillOnWaitRequest(Request):
@@ -1398,6 +1496,16 @@ class Distgraphcomm(Comm):
         per in-edge (MPI_Neighbor_alltoall)."""
         return self._c.neighbor_alltoall(sendobj)
 
+    def ineighbor_allgather(self, sendobj: Any) -> Request:
+        """Nonblocking :meth:`neighbor_allgather`
+        (MPI_Ineighbor_allgather); complete via ``Request.wait()``."""
+        return Request(self._c.ineighbor_allgather(sendobj))
+
+    def ineighbor_alltoall(self, sendobj: List[Any]) -> Request:
+        """Nonblocking :meth:`neighbor_alltoall`
+        (MPI_Ineighbor_alltoall); complete via ``Request.wait()``."""
+        return Request(self._c.ineighbor_alltoall(sendobj))
+
 
 class Graphcomm(Distgraphcomm):
     """mpi4py ``MPI.Graphcomm`` over
@@ -1617,6 +1725,34 @@ class Win:
         win._disp_unit = int(disp_unit)
         win._itemsize = int(mem.dtype.itemsize)
         return win
+
+    @classmethod
+    def Allocate(cls, size: int, disp_unit: int = 1, info: Any = None,
+                 comm: Optional[Comm] = None) -> "Win":
+        """``MPI_Win_allocate``: allocate ``size`` bytes on this rank
+        and expose them as a window (retrieve the buffer with
+        :meth:`tomemory`). Collective; same ``disp_unit``/``info``
+        semantics as :meth:`Create`."""
+        size = int(size)
+        if size < 0:
+            raise api.MpiError(
+                f"mpi_tpu.compat: Win.Allocate size must be >= 0, "
+                f"got {size}")
+        return cls.Create(np.zeros(size, np.uint8),
+                          disp_unit=disp_unit, info=info, comm=comm)
+
+    @classmethod
+    def Allocate_shared(cls, size: int, disp_unit: int = 1,
+                        info: Any = None,
+                        comm: Optional[Comm] = None) -> "Win":
+        """``MPI_Win_allocate_shared``: like :meth:`Allocate`, with
+        the members' buffers addressable via :meth:`Shared_query`.
+        Direct cross-rank loads/stores need a shared address space —
+        the thread-per-rank xla driver provides one; on cross-process
+        drivers ``Shared_query`` raises and RMA goes through
+        put/get + fences (the window itself works everywhere)."""
+        return cls.Allocate(size, disp_unit=disp_unit, info=info,
+                            comm=comm)
 
     @property
     def native(self):
@@ -2525,6 +2661,72 @@ class Datatype:
         self._unpack(buf, data, count, "Unpack")
         return position + nbytes
 
+    # -- external32 portable pack (MPI_Pack_external family) ---------------
+
+    def _external_check(self, datarep: str, what: str) -> None:
+        if datarep != "external32":
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what} supports datarep "
+                f"'external32' only, got {datarep!r}")
+        if self._struct:
+            raise api.MpiError(
+                f"mpi_tpu.compat: {what} on struct datatypes is not "
+                f"supported (per-component representations differ); "
+                f"pack components with their own datatypes sharing "
+                f"one position cursor")
+
+    def Pack_external_size(self, datarep: str, count: int) -> int:
+        """``MPI_Pack_external_size``: bytes ``count`` items occupy in
+        the portable external32 representation (big-endian IEEE — the
+        same sizes as the native layout for the basic types here)."""
+        self._external_check(datarep, "Pack_external_size")
+        return int(count) * self.Get_size()
+
+    def Pack_external(self, datarep: str, inbuf: Any, outbuf: Any,
+                      position: int) -> int:
+        """``MPI_Pack_external``: like :meth:`Pack`, but the packed
+        bytes are the canonical big-endian external32 encoding, so a
+        buffer packed here unpacks identically on any platform."""
+        self._external_check(datarep, "Pack_external")
+        buf, count = self._pack_spec(inbuf, "Pack_external")
+        data = np.ascontiguousarray(self._pack(buf, count,
+                                               "Pack_external"))
+        raw = data.astype(data.dtype.newbyteorder(">"),
+                          copy=False).view(np.uint8)
+        out = self._byte_view(outbuf, "Pack_external", writable=True)
+        position = int(position)
+        if position < 0 or position + raw.size > out.size:
+            raise api.MpiError(
+                f"mpi_tpu.compat: Pack_external of {raw.size} bytes "
+                f"at position {position} overruns the {out.size}-byte "
+                f"buffer")
+        out[position:position + raw.size] = raw
+        return position + raw.size
+
+    def Unpack_external(self, datarep: str, inbuf: Any, position: int,
+                        outbuf: Any) -> int:
+        """``MPI_Unpack_external``: inverse of :meth:`Pack_external`
+        — reads the big-endian external32 bytes and delivers items in
+        this platform's native layout."""
+        self._external_check(datarep, "Unpack_external")
+        src = self._byte_view(inbuf, "Unpack_external", writable=False)
+        buf, count = self._pack_spec(outbuf, "Unpack_external")
+        if count is None:
+            flat = self._flat(buf, "Unpack_external", writable=True)
+            count = self._infer_count(flat.size, "Unpack_external")
+        nbytes = count * self.Get_size()
+        position = int(position)
+        if position < 0 or position + nbytes > src.size:
+            raise api.MpiError(
+                f"mpi_tpu.compat: Unpack_external of {nbytes} bytes "
+                f"at position {position} overruns the {src.size}-byte "
+                f"buffer")
+        big = src[position:position + nbytes].view(
+            self._base.newbyteorder(">"))
+        self._unpack(buf, big.astype(self._base), count,
+                     "Unpack_external")
+        return position + nbytes
+
     # -- pack / unpack ------------------------------------------------------
 
     def _flat(self, buf: Any, what: str, writable: bool) -> np.ndarray:
@@ -2911,6 +3113,7 @@ class _MPI:
     Status = Status
     Request = Request
     Prequest = Prequest
+    Grequest = Grequest
     Comm = Comm
     Message = Message
     Info = Info
@@ -2918,8 +3121,25 @@ class _MPI:
     Errhandler = Errhandler
     ERRORS_RETURN = ERRORS_RETURN
     ERRORS_ARE_FATAL = ERRORS_ARE_FATAL
-    # mpi4py raises MPI.Exception; here every error IS MpiError.
+    # mpi4py raises MPI.Exception; here every error IS MpiError, and
+    # it carries the mpi4py error-class protocol (Get_error_class /
+    # Get_error_code / Get_error_string — api.py), so
+    # `except MPI.Exception as e: e.Get_error_class() == MPI.ERR_RANK`
+    # works unchanged. The MPI.ERR_* constants (MPICH numbering) and
+    # module-level Get_error_class/Get_error_string live below.
     Exception = api.MpiError
+    SUCCESS = _errclass.SUCCESS
+    ERR_LASTCODE = _errclass.ERR_LASTCODE
+
+    @staticmethod
+    def Get_error_class(errorcode: int) -> int:
+        """MPI_Error_class for an integer error code."""
+        return _errclass.error_class(errorcode)
+
+    @staticmethod
+    def Get_error_string(errorcode: int) -> str:
+        """MPI_Error_string for an integer error code."""
+        return _errclass.error_string(errorcode)
     Group = Group
     Cartcomm = Cartcomm
     Distgraphcomm = Distgraphcomm
@@ -3082,5 +3302,11 @@ class _MPI:
     def Wtick(self) -> float:
         return api.wtick()
 
+
+# The full MPI.ERR_* constant set (MPICH numbering, errclass.py) —
+# attached programmatically so the table lives in ONE place.
+for _name, _code in _errclass._NAME_TO_CODE.items():
+    setattr(_MPI, _name, _code)
+del _name, _code
 
 MPI = _MPI()

@@ -190,6 +190,18 @@ class DistGraphComm(Comm):
         return self.neighbor_alltoall(
             [data] * len(self._destinations), tag=tag)
 
+    # Nonblocking neighborhood collectives (MPI_Ineighbor_*): the
+    # blocking edge-exchange on a worker thread, completion via
+    # Request — the same launch-order contract as every I-collective
+    # (api._chained_request serializes starts per communicator).
+
+    def ineighbor_alltoall(self, data: List[Any],
+                           tag: int = 0) -> "Request":
+        return self._icoll("neighbor_alltoall", data, tag=tag)
+
+    def ineighbor_allgather(self, data: Any, tag: int = 0) -> "Request":
+        return self._icoll("neighbor_allgather", data, tag=tag)
+
 
 def graph_create(comm: Comm, index: Sequence[int],
                  edges: Sequence[int],

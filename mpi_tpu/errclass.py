@@ -1,0 +1,148 @@
+"""MPI error classes — integer codes for programmatic error handling.
+
+The reference panics on every failure (mpi.go:20-21) and mpi4py
+surfaces ``MPI.Exception`` objects whose ``Get_error_class()`` returns
+one of the standard ``MPI_ERR_*`` integers. This framework raises rich
+typed exceptions (:class:`~mpi_tpu.api.MpiError` subclasses with full
+prose), so the error CLASS is derived, not stored: an explicit
+``(MPI_ERR_XXX)`` marker in the message wins, then the exception's
+type, then a conservative keyword scan — ``ERR_OTHER`` when nothing
+matches (never a wrong specific class).
+
+Numbering follows MPICH's canonical layout (MPI standard annex order:
+``MPI_SUCCESS == 0``, the MPI-1 classes 1..19, then the MPI-2 set), so
+codes are stable across releases and comparable to what mpi4py users
+expect to read in logs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+SUCCESS = 0
+ERR_BUFFER = 1
+ERR_COUNT = 2
+ERR_TYPE = 3
+ERR_TAG = 4
+ERR_COMM = 5
+ERR_RANK = 6
+ERR_REQUEST = 7
+ERR_ROOT = 8
+ERR_GROUP = 9
+ERR_OP = 10
+ERR_TOPOLOGY = 11
+ERR_DIMS = 12
+ERR_ARG = 13
+ERR_UNKNOWN = 14
+ERR_TRUNCATE = 15
+ERR_OTHER = 16
+ERR_INTERN = 17
+ERR_IN_STATUS = 18
+ERR_PENDING = 19
+ERR_ACCESS = 20
+ERR_AMODE = 21
+ERR_ASSERT = 22
+ERR_BAD_FILE = 23
+ERR_BASE = 24
+ERR_CONVERSION = 25
+ERR_DISP = 26
+ERR_DUP_DATAREP = 27
+ERR_FILE_EXISTS = 28
+ERR_FILE_IN_USE = 29
+ERR_FILE = 30
+ERR_INFO_KEY = 31
+ERR_INFO_NOKEY = 32
+ERR_INFO_VALUE = 33
+ERR_INFO = 34
+ERR_IO = 35
+ERR_KEYVAL = 36
+ERR_LOCKTYPE = 37
+ERR_NAME = 38
+ERR_NO_MEM = 39
+ERR_NOT_SAME = 40
+ERR_NO_SPACE = 41
+ERR_NO_SUCH_FILE = 42
+ERR_PORT = 43
+ERR_QUOTA = 44
+ERR_READ_ONLY = 45
+ERR_RMA_CONFLICT = 46
+ERR_RMA_SYNC = 47
+ERR_SERVICE = 48
+ERR_SIZE = 49
+ERR_SPAWN = 50
+ERR_UNSUPPORTED_DATAREP = 51
+ERR_UNSUPPORTED_OPERATION = 52
+ERR_WIN = 53
+ERR_SESSION = 54
+ERR_LASTCODE = 1073741823  # MPICH's MPI_ERR_LASTCODE
+
+_NAME_TO_CODE = {k: v for k, v in globals().items()
+                 if k.startswith("ERR_") and isinstance(v, int)}
+_CODE_TO_NAME = {v: k for k, v in _NAME_TO_CODE.items()}
+_CODE_TO_NAME[SUCCESS] = "SUCCESS"
+
+_MARKER = re.compile(r"MPI_(ERR_[A-Z_]+)")
+
+# Conservative message-keyword fallbacks, checked in order: only
+# phrases this codebase actually emits, mapped to the class an MPI
+# implementation would report for the same misuse.
+_KEYWORDS = (
+    ("tag", ERR_TAG),
+    ("rank", ERR_RANK),
+    ("root", ERR_ROOT),
+    ("window", ERR_WIN),
+    ("group", ERR_GROUP),
+    ("datatype", ERR_TYPE),
+    ("truncat", ERR_TRUNCATE),
+    ("reduction op", ERR_OP),
+    ("file", ERR_FILE),
+    ("session", ERR_SESSION),
+    ("spawn", ERR_SPAWN),
+    ("port", ERR_PORT),
+    ("info", ERR_INFO),
+    ("payload mismatch", ERR_TRUNCATE),
+)
+
+
+def classify(exc: BaseException) -> int:
+    """The MPI error class for an exception raised by this framework.
+
+    Precedence: explicit ``(MPI_ERR_XXX)`` marker in the message >
+    exception type > message keywords > ``ERR_OTHER``. Never raises."""
+    msg = str(exc)
+    m = _MARKER.search(msg)
+    if m and m.group(1) in _NAME_TO_CODE:
+        return _NAME_TO_CODE[m.group(1)]
+    # Type-based mapping (import deferred: api imports this module).
+    from . import api as _api
+    from .backends.tcp import InitError, ReceiveCancelled
+
+    if isinstance(exc, _api.TagError):
+        return ERR_TAG
+    if isinstance(exc, ReceiveCancelled):
+        return ERR_PENDING
+    if isinstance(exc, (InitError, _api.NotInitializedError)):
+        return ERR_OTHER
+    low = msg.lower()
+    for needle, code in _KEYWORDS:
+        if needle in low:
+            return code
+    return ERR_OTHER if isinstance(exc, _api.MpiError) else ERR_UNKNOWN
+
+
+def error_string(code: int) -> str:
+    """Human-readable name for an error class (MPI_Error_string)."""
+    name = _CODE_TO_NAME.get(code)
+    if name is None:
+        return f"unknown MPI error code {code}"
+    if name == "SUCCESS":
+        return "MPI_SUCCESS: no error"
+    return f"MPI_{name}"
+
+
+def error_class(code: int) -> int:
+    """MPI_Error_class: map an error CODE to its class. This framework
+    does not mint implementation-specific codes beyond the classes, so
+    valid codes map to themselves; unknown codes report ERR_UNKNOWN."""
+    return code if code in _CODE_TO_NAME else ERR_UNKNOWN

@@ -28,7 +28,10 @@ while true; do
   # Yield to any foreign bench run (the driver's end-of-round run, a
   # test-suite smoke): the probe's python process competes for the
   # box's single core and measurably skews host-side timing legs.
-  if pgrep -f "[b]ench.py" > /dev/null 2>&1; then
+  # Match an actual python invocation of bench.py only — a bare
+  # "bench.py" substring also matches the round driver's prompt text
+  # in its own argv, which would wedge the watcher forever.
+  if pgrep -f "python[^ ]* ([^ ]*/)?bench\.py" > /dev/null 2>&1; then
     sleep 120
     continue
   fi
