@@ -31,6 +31,7 @@ point-to-point library over TCP, /root/reference) designed TPU-first:
 """
 
 from .comm import CartComm, Comm, cart_create, comm_self, comm_world
+from .compressed import allreduce_compressed_wire
 from .distgraph import (DistGraphComm, GraphComm,
                         dist_graph_create_adjacent, graph_create)
 from .intercomm import Intercomm, create_intercomm
@@ -114,6 +115,7 @@ __all__ = [
     "TagError",
     "allgather",
     "allreduce",
+    "allreduce_compressed_wire",
     "alltoall",
     "barrier",
     "iallreduce",

@@ -1,0 +1,228 @@
+"""Int8-compressed allreduce over the socket drivers — the wire twin
+of :func:`mpi_tpu.parallel.quantized_allreduce`.
+
+Round-5 decomposition (docs/PERF_NOTES.md): on the socket fabric the
+exact float allreduce is wire-bound at >= 64 MiB, and an int8 path
+(4x fewer wire bytes + per-block float32 scales) beats it **iff**
+quantization costs ~one memory pass. numpy's ~7 full-array passes
+erase the margin, so the hot loops live in ``native/quantcore.cpp``
+(fused single-pass kernels, GIL released); the numpy fallback keeps
+the path correct — just not profitable — under ``MPI_TPU_NO_NATIVE``.
+
+Algorithm (EQuARX-style two-phase, one quantization per phase, so the
+elementwise error is bounded by TWO roundings regardless of rank
+count — the same contract as the XLA version, quantized.py:17-32):
+
+1. **reduce-scatter**: every rank splits its vector into ``n`` rank
+   shards and quantizes each (including its own); shard ``d`` travels
+   to rank ``d`` in ``n-1`` rotation rounds (send to ``me+r``,
+   receive from ``me-r`` — the deadlock-free pairwise schedule the
+   ring phases use); the receiver dequant-accumulates in float32 **in
+   rank order** (deterministic).
+2. **allgather**: the reduced shard is quantized once more and
+   rotated to every rank; each shard dequantizes into its slot.
+
+Error bound: ``|err| <= 0.5 * (sum_i s1_i + s2)`` with ``s1_i`` rank
+i's phase-1 scale for the element's block and ``s2`` the phase-2
+scale — asserted exactly by the unit tests. A block containing
+NaN/inf quantizes to scale NaN, so divergence propagates loudly.
+
+This is LOSSY and therefore **never** dispatched by the exact
+:func:`~mpi_tpu.collectives_generic.allreduce`; callers opt in, and
+:func:`wire_compressed_eligible` records the measured crossover the
+same way ``ring_eligible``/``quantized_eligible`` do.
+
+No reference analogue (btracey/mpi stubs collectives, mpi.go:130).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any, Tuple
+
+import numpy as np
+
+from .api import Interface, MpiError, exchange as _exchange
+from .collectives_generic import _next_tag_base
+
+__all__ = ["allreduce_compressed_wire", "wire_compressed_eligible",
+           "WIRE_QUANTIZED_MIN_BYTES", "quantize_np", "dequantize_np"]
+
+_BLOCK = 1024
+
+# Measured crossover for the SOCKET fabric. None = never: on the
+# 1-core loopback box the REAL path loses at every size (4 ranks,
+# vectorized kernels, interleaved A/B: 0.47x @ 16 MiB, 0.69x @
+# 64 MiB, 0.21x @ 256 MiB — all four ranks' quantize/accumulate
+# passes serialize onto the one core, while on a real deployment each
+# rank owns its core and the wire is the shared resource). The
+# decomposition bound (PERF_NOTES.md) shows the win appears exactly
+# when per-rank compute runs concurrently: enable on such a fabric
+# with MPI_TPU_WIRE_QUANTIZED_MIN=<bytes> after an on-fabric A/B —
+# the same experimental-DCN discipline as the pipeline lever.
+WIRE_QUANTIZED_MIN_BYTES = None
+
+
+def wire_compressed_eligible(nbytes: int) -> bool:
+    """True when the compressed path is expected to beat the exact
+    float allreduce on the socket fabric (measured gate; same
+    never-lose discipline as ``ring_eligible``)."""
+    env = os.environ.get("MPI_TPU_WIRE_QUANTIZED_MIN")
+    threshold = WIRE_QUANTIZED_MIN_BYTES
+    if env is not None:
+        try:
+            threshold = int(env)
+        except ValueError:
+            import warnings
+
+            warnings.warn(
+                f"mpi_tpu: MPI_TPU_WIRE_QUANTIZED_MIN={env!r} is not "
+                f"an integer byte count — compressed wire allreduce "
+                f"stays OFF", RuntimeWarning, stacklevel=2)
+    return threshold is not None and nbytes >= threshold
+
+
+def _qc():
+    from . import native as _native
+
+    return _native.quantcore()
+
+
+def _ptr(arr: np.ndarray):
+    return ctypes.c_void_p(arr.ctypes.data)
+
+
+def _check_f32_blocked(x: np.ndarray, block: int,
+                       what: str) -> np.ndarray:
+    """The kernels reinterpret raw memory: a float64 buffer or a
+    strided view would silently produce garbage on the native path
+    that the numpy fallback rejects — validate identically on both."""
+    x = np.asarray(x)
+    if x.dtype != np.float32:
+        raise MpiError(
+            f"mpi_tpu: {what} operates on float32 vectors; got "
+            f"{x.dtype} (cast explicitly — the quantization grid "
+            f"depends on the dtype)")
+    if x.size % block:
+        raise MpiError(
+            f"mpi_tpu: {what} needs size ({x.size}) divisible by "
+            f"block ({block}); pad the vector")
+    return np.ascontiguousarray(x.reshape(-1))
+
+
+def quantize_np(x: np.ndarray, block: int = _BLOCK
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Blockwise symmetric int8 quantization of a float32 vector whose
+    size divides ``block`` — native kernel when available, numpy
+    otherwise; bit-identical semantics to
+    ``parallel.quantized.quantize_blocks``."""
+    x = _check_f32_blocked(x, block, "quantize_np")
+    lib = _qc()
+    if lib is not None:
+        q = np.empty(x.size, np.int8)
+        s = np.empty(x.size // block, np.float32)
+        lib.qc_quantize(_ptr(x), x.size, block, _ptr(q), _ptr(s))
+        return q, s
+    xb = x.reshape(-1, block)
+    amax = np.max(np.abs(xb), axis=1)
+    finite = np.isfinite(amax)
+    safe = np.where(finite & (amax > 0), amax, np.float32(127.0))
+    s = (safe / 127.0).astype(np.float32)
+    q = np.clip(np.round(xb / s[:, None]), -127, 127)
+    q = np.where(np.isnan(q), 0, q).astype(np.int8).reshape(-1)
+    s = np.where(finite, s, np.float32(np.nan)).astype(np.float32)
+    return q, s
+
+
+def _check_qs(q: np.ndarray, s: np.ndarray, block: int,
+              what: str) -> Tuple[np.ndarray, np.ndarray]:
+    q = np.ascontiguousarray(np.asarray(q).reshape(-1))
+    s = np.ascontiguousarray(np.asarray(s).reshape(-1))
+    if q.dtype != np.int8 or s.dtype != np.float32 \
+            or q.size != s.size * block:
+        raise MpiError(
+            f"mpi_tpu: {what} expects (int8[{block}*nblk], "
+            f"float32[nblk]); got {q.dtype}[{q.size}], "
+            f"{s.dtype}[{s.size}]")
+    return q, s
+
+
+def _accumulate(q: np.ndarray, s: np.ndarray, acc: np.ndarray,
+                block: int) -> None:
+    q, s = _check_qs(q, s, block, "accumulate")
+    lib = _qc()
+    if lib is not None:
+        lib.qc_accumulate(_ptr(q), _ptr(s), q.size, block, _ptr(acc))
+        return
+    acc += (q.astype(np.float32).reshape(-1, block)
+            * s[:, None]).reshape(-1)
+
+
+def dequantize_np(q: np.ndarray, s: np.ndarray, block: int = _BLOCK
+                  ) -> np.ndarray:
+    """Inverse of :func:`quantize_np` (float32)."""
+    q, s = _check_qs(q, s, block, "dequantize_np")
+    lib = _qc()
+    if lib is not None:
+        out = np.empty(q.size, np.float32)
+        lib.qc_dequantize(_ptr(q), _ptr(s), q.size, block, _ptr(out))
+        return out
+    return (q.astype(np.float32).reshape(-1, block)
+            * s[:, None]).reshape(-1)
+
+
+def allreduce_compressed_wire(impl: Interface, data: Any,
+                              block: int = _BLOCK) -> np.ndarray:
+    """Sum-allreduce with int8-compressed wire traffic over any socket
+    driver (module doc). Float payloads only; accumulation in float32;
+    returns ``data``'s shape and dtype. LOSSY — two int8 roundings."""
+    arr = np.asarray(data)
+    if not np.issubdtype(arr.dtype, np.floating):
+        raise MpiError(
+            f"mpi_tpu: allreduce_compressed_wire compresses float "
+            f"payloads; got {arr.dtype} (integer reductions must be "
+            f"exact — use allreduce)")
+    n, me = impl.size(), impl.rank()
+    flat = arr.reshape(-1).astype(np.float32, copy=False)
+    if n == 1:
+        return flat.astype(arr.dtype, copy=True).reshape(arr.shape)
+    m = flat.size
+    chunk = -(-m // (n * block)) * block       # elements per rank shard
+    padded = np.zeros(n * chunk, np.float32)
+    padded[:m] = flat
+    tag = _next_tag_base(impl)
+
+    # Phase 1: quantize all n shards once, rotate each to its owner,
+    # dequant-accumulate IN RANK ORDER (round order is timing-fixed,
+    # but the sum must fold 0..n-1 deterministically — stage arrivals
+    # and fold after the exchanges).
+    q, s = quantize_np(padded, block)
+    sblk = chunk // block
+    q_shards = q.reshape(n, chunk)
+    s_shards = s.reshape(n, sblk)
+    arrived: dict = {me: (q_shards[me], s_shards[me])}
+    for r in range(1, n):
+        dst, src = (me + r) % n, (me - r) % n
+        got_q = _exchange(impl, np.ascontiguousarray(q_shards[dst]),
+                          dst, src, tag + 2 * r)
+        got_s = _exchange(impl, np.ascontiguousarray(s_shards[dst]),
+                          dst, src, tag + 2 * r + 1)
+        arrived[src] = (np.asarray(got_q), np.asarray(got_s))
+    acc = np.zeros(chunk, np.float32)
+    for r in range(n):                          # canonical rank order
+        _accumulate(*arrived[r], acc, block)
+
+    # Phase 2: one more quantization, rotate the reduced shard to
+    # every rank, dequantize into place.
+    q2, s2 = quantize_np(acc, block)
+    out = np.empty(n * chunk, np.float32)
+    out[me * chunk:(me + 1) * chunk] = dequantize_np(q2, s2, block)
+    base2 = tag + 2 * n
+    for r in range(1, n):
+        dst, src = (me + r) % n, (me - r) % n
+        got_q = _exchange(impl, q2, dst, src, base2 + 2 * r)
+        got_s = _exchange(impl, s2, dst, src, base2 + 2 * r + 1)
+        out[src * chunk:(src + 1) * chunk] = dequantize_np(
+            np.asarray(got_q), np.asarray(got_s), block)
+    return out[:m].astype(arr.dtype, copy=False).reshape(arr.shape)

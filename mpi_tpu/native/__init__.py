@@ -29,7 +29,8 @@ import tempfile
 import threading
 from typing import Callable, Dict, Optional
 
-__all__ = ["wirecore", "shmcore", "dataloader", "available", "build_error"]
+__all__ = ["wirecore", "shmcore", "dataloader", "quantcore",
+           "available", "build_error"]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
@@ -111,6 +112,40 @@ def _configure_shmcore(lib: ctypes.CDLL) -> None:
         raise RuntimeError("shmcore version mismatch")
 
 
+def _cpu_tag() -> str:
+    """Short stable tag for this machine's ISA (model + feature
+    flags), for caching -march=native artifacts per CPU type."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            text = f.read()
+        lines = [ln for ln in text.splitlines()
+                 if ln.startswith(("model name", "flags"))][:2]
+        basis = "|".join(lines)
+    except OSError:
+        import platform
+
+        basis = platform.processor() or platform.machine()
+    return hashlib.sha256(basis.encode()).hexdigest()[:10]
+
+
+def _configure_quantcore(lib: ctypes.CDLL) -> None:
+    for name in ("qc_quantize", "qc_accumulate", "qc_dequantize"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int
+    lib.qc_quantize.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.c_void_p, ctypes.c_void_p]
+    lib.qc_accumulate.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_uint32, ctypes.c_void_p]
+    lib.qc_dequantize.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_uint32, ctypes.c_void_p]
+    lib.qc_version.restype = ctypes.c_int
+    if lib.qc_version() != 1:
+        raise RuntimeError("quantcore version mismatch")
+
+
 class _Lib:
     """Lazy build+load state for one native library."""
 
@@ -127,6 +162,13 @@ class _Lib:
     def _build(self) -> ctypes.CDLL:
         with open(self.src, "rb") as f:
             digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        if self.stem == "quantcore":
+            # -march=native builds are CPU-specific, and the cache dir
+            # can live on a $HOME shared across heterogeneous nodes
+            # (the norm on HPC clusters): key the artifact by this
+            # machine's ISA too, or an AVX-512 build loaded on an
+            # older node dies with SIGILL inside the kernel.
+            digest += "-" + _cpu_tag()
         out_dir = _cache_dir()
         os.makedirs(out_dir, exist_ok=True)
         so_path = os.path.join(out_dir, f"{self.stem}-{digest}.so")
@@ -135,6 +177,13 @@ class _Lib:
             os.close(fd)
             cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                    self.src, "-o", tmp, "-pthread"]
+            if self.stem == "quantcore":
+                # Streaming arithmetic kernels: let the compiler
+                # vectorize for THIS machine (the cache is per-user,
+                # per-source-hash, built where it runs — never
+                # shipped). NOT -ffast-math: the NaN-poisoning
+                # semantics are contractual.
+                cmd[1:2] = ["-O3", "-march=native", "-funroll-loops"]
             try:
                 try:
                     subprocess.run(cmd, check=True, capture_output=True,
@@ -186,6 +235,7 @@ _LIBS: Dict[str, _Lib] = {
     "wirecore": _Lib("wirecore", _configure_wirecore),
     "shmcore": _Lib("shmcore", _configure_shmcore),
     "dataloader": _Lib("dataloader", _configure_dataloader),
+    "quantcore": _Lib("quantcore", _configure_quantcore),
 }
 
 
@@ -203,6 +253,12 @@ def shmcore() -> Optional[ctypes.CDLL]:
 def dataloader() -> Optional[ctypes.CDLL]:
     """The loaded batch-gather kernel; None if unavailable."""
     return _LIBS["dataloader"].load()
+
+
+def quantcore() -> Optional[ctypes.CDLL]:
+    """The loaded int8 quantization kernels (compressed wire
+    allreduce); None if unavailable."""
+    return _LIBS["quantcore"].load()
 
 
 def available(stem: str = "wirecore") -> bool:

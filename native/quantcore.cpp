@@ -1,0 +1,97 @@
+// quantcore — blockwise int8 quantization kernels for the compressed
+// wire allreduce (mpi_tpu/compressed.py:allreduce_compressed_wire).
+//
+// The decomposition measurement behind this library (round 5,
+// docs/PERF_NOTES.md): on the socket fabric the int8 path's wire
+// saving (4x fewer bytes) beats the exact float allreduce at >= 64 MiB
+// ONLY if quantization costs ~one memory pass — numpy's ~7 full-array
+// passes (abs, max, divide, round, clip, cast, multiply) erase the
+// margin. These kernels fuse each phase into a single streaming pass,
+// called via ctypes (GIL released for the whole call, like wirecore).
+//
+// Semantics mirror mpi_tpu/parallel/quantized.py:quantize_blocks
+// exactly: symmetric per-block scaling s = amax/127 (amax == 0 ->
+// s = 1), q = clip(round(x/s), -127, 127); a block containing
+// non-finite values gets scale = NaN so divergence stays loud through
+// dequantization instead of being laundered into finite garbage.
+//
+// All functions return 0; n must be a multiple of block (the Python
+// caller pads). Little-endian irrelevant here (no wire framing).
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+// q[i] = clip(round(x[i]/s_blk)); one pass, amax and quantize fused
+// per block (the block re-read hits L1/L2 by construction:
+// block <= 4096 floats = 16 KiB).
+int qc_quantize(const float *x, uint64_t n, uint32_t block,
+                int8_t *q, float *scales) {
+  const uint64_t nblk = n / block;
+  for (uint64_t b = 0; b < nblk; ++b) {
+    const float *xb = x + b * block;
+    float amax = 0.0f;
+    bool finite = true;
+    for (uint32_t i = 0; i < block; ++i) {
+      const float v = xb[i];
+      if (!std::isfinite(v)) finite = false;
+      const float a = std::fabs(v);
+      if (a > amax) amax = a;
+    }
+    // Bit-identical to the numpy reference (quantize_np): the SAFE
+    // value ignores a non-finite amax (safe=127 -> s=1, matching
+    // np.where(finite & (amax > 0), amax, 127.0)), and the quantize
+    // DIVIDES by s — an x * (1/s) would round differently by 1 ulp
+    // near half-integers and break the exact parity test.
+    const float safe = (finite && amax > 0.0f) ? amax : 127.0f;
+    const float s = safe / 127.0f;
+    int8_t *qb = q + b * block;
+    for (uint32_t i = 0; i < block; ++i) {
+      float r = std::nearbyintf(xb[i] / s);
+      if (r > 127.0f) r = 127.0f;
+      if (r < -127.0f) r = -127.0f;
+      // NaN input: NaN/s rounds to NaN, comparisons fail, and the
+      // cast below is UB — map it to 0; the NaN SCALE poisons the
+      // whole block at dequantization anyway.
+      qb[i] = std::isnan(r) ? 0 : static_cast<int8_t>(r);
+    }
+    scales[b] = finite ? s : std::nanf("");
+  }
+  return 0;
+}
+
+// acc[i] += q[i] * s_blk — the dequantizing accumulation of one
+// rank's quantized shard into the float32 partial (phase 1).
+int qc_accumulate(const int8_t *q, const float *scales, uint64_t n,
+                  uint32_t block, float *acc) {
+  const uint64_t nblk = n / block;
+  for (uint64_t b = 0; b < nblk; ++b) {
+    const float s = scales[b];
+    const int8_t *qb = q + b * block;
+    float *ab = acc + b * block;
+    for (uint32_t i = 0; i < block; ++i) {
+      ab[i] += static_cast<float>(qb[i]) * s;
+    }
+  }
+  return 0;
+}
+
+// out[i] = q[i] * s_blk (phase-2 expansion of the gathered shards).
+int qc_dequantize(const int8_t *q, const float *scales, uint64_t n,
+                  uint32_t block, float *out) {
+  const uint64_t nblk = n / block;
+  for (uint64_t b = 0; b < nblk; ++b) {
+    const float s = scales[b];
+    const int8_t *qb = q + b * block;
+    float *ob = out + b * block;
+    for (uint32_t i = 0; i < block; ++i) {
+      ob[i] = static_cast<float>(qb[i]) * s;
+    }
+  }
+  return 0;
+}
+
+int qc_version() { return 1; }
+
+}  // extern "C"
