@@ -57,9 +57,10 @@ v-variants (``Gatherv``/``Scatterv``/``Allgatherv``/``Alltoallv``)
 take the ``[buf, counts, displs, datatype]`` spec.
 
 Scope honesty: this is the commonly-used core surface, not all of
-mpi4py (``Create_struct`` handles mixed-base records with named-basic
-components — nest derived layouts via vector-of-struct, not
-struct-of-derived; dynamic process management covers ``Comm.Spawn`` /
+mpi4py (``Create_struct`` accepts any component datatype — basics,
+vectors, resized strides, nested structs — laying each out by its
+own byte pattern and extent;
+dynamic process management covers ``Comm.Spawn`` /
 ``Get_parent`` / ``Disconnect`` and ``Open_port`` /
 ``Comm.Accept`` / ``Comm.Connect``; the MPI-4 Sessions surface
 (``MPI.Session.Init`` → psets → ``Group.Create_from_session_pset``
@@ -2501,9 +2502,14 @@ class Datatype:
         field offsets feed ``displacements`` directly. The result
         addresses the buffer's raw bytes (any buffer dtype works; a
         structured record array is the natural one), so alignment
-        holes between fields never travel. Component datatypes must be
-        the named basics (identity layout — nest derived layouts via
-        ``Create_vector``-of-struct instead, as common MPI codes do)."""
+        holes between fields never travel.
+
+        Components may be ANY datatype (round 5): a derived component
+        contributes its own byte layout per item, with consecutive
+        items of a block striding by the component's EXTENT — so
+        vector-typed fields, resized basics (stride = resized
+        extent, MPI's meaning), and nested structs all lay out
+        exactly as mpi4py would."""
         blocklengths = [int(b) for b in blocklengths]
         displacements = [int(d) for d in displacements]
         if not (len(blocklengths) == len(displacements)
@@ -2511,31 +2517,35 @@ class Datatype:
             raise api.MpiError(
                 "mpi_tpu.compat: Create_struct needs equal-length "
                 "non-empty blocklengths/displacements/datatypes")
-        spans = []
+        spans, tails = [], []
         for i, (bl, disp, dt) in enumerate(
                 zip(blocklengths, displacements, datatypes)):
             if not isinstance(dt, Datatype):
                 raise api.MpiError(
                     f"mpi_tpu.compat: Create_struct datatypes[{i}] is "
                     f"not an MPI.Datatype")
-            if dt._offsets.size != 1 or dt._offsets[0] != 0 \
-                    or dt._extent_elems != 1:
-                # The extent check matters too: a RESIZED basic would
-                # pass the layout test but its MPI meaning (stride =
-                # resized extent between the block's elements) is not
-                # what the byte-span below builds — reject rather than
-                # silently lay records out differently from mpi4py.
-                raise api.MpiError(
-                    f"mpi_tpu.compat: Create_struct datatypes[{i}] "
-                    f"({dt!r}) is a derived layout; struct components "
-                    f"must be named basics")
+            dt._check_not_freed(f"Create_struct (datatypes[{i}])")
             if bl < 1 or disp < 0:
                 raise api.MpiError(
                     f"mpi_tpu.compat: Create_struct block {i}: need "
                     f"blocklength >= 1 and displacement >= 0, got "
                     f"({bl}, {disp})")
-            spans.append(disp + np.arange(bl * dt._base.itemsize,
-                                          dtype=np.int64))
+            # One item's byte layout: every element offset expanded to
+            # its bytes (identity for a basic: arange(itemsize); the
+            # component's own gather order for derived/struct types).
+            isz = dt._base.itemsize
+            elem_bytes = (dt._offsets.astype(np.int64)[:, None] * isz
+                          + np.arange(isz, dtype=np.int64)).reshape(-1)
+            stride = int(dt._extent_elems) * isz     # item-to-item
+            item = (np.arange(bl, dtype=np.int64)[:, None] * stride
+                    + elem_bytes[None, :]).reshape(-1)
+            spans.append(disp + item)
+            # A resized component's TRAILING padding is part of the
+            # record too (mpi4py's ub marker sits at
+            # disp + bl*extent): track it so the struct's extent
+            # matches, or count>1 sends would stride records
+            # differently than a real MPI peer.
+            tails.append(disp + bl * stride)
         offsets = np.concatenate(spans)
         if np.unique(offsets).size != offsets.size:
             raise api.MpiError(
@@ -2544,7 +2554,8 @@ class Datatype:
         names = ",".join(f"{bl}x{dt._name}@{disp}" for bl, disp, dt in
                          zip(blocklengths, displacements, datatypes))
         out = Datatype(np.uint8, offsets,
-                       extent=int(offsets.max()) + 1,
+                       extent=max(int(offsets.max()) + 1,
+                                  int(max(tails))),
                        name=f"struct({names})", committed=False)
         out._struct = True
         return out

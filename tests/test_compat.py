@@ -1833,23 +1833,30 @@ class TestCreateStruct:
             assert "overlap" in str(exc)
         else:
             raise AssertionError("overlapping struct accepted")
-        # Derived components are out of scope (documented).
+        # Derived components build their own byte layouts (round 5).
+        # vector(2 blocks of 1 double, stride 3): elements at byte
+        # offsets 0 and 24 within the component.
         vec = MPI.DOUBLE.Create_vector(2, 1, 3)
+        st_v = MPI.Datatype.Create_struct([1], [0], [vec])
+        assert sorted(set(st_v._offsets // 8)) == [0, 3]
+        # A RESIZED basic strides consecutive block items by the
+        # resized extent — MPI's meaning: 2 ints, 8 bytes apart — and
+        # the TRAILING pad stays in the struct's extent (mpi4py's ub
+        # marker at disp + bl*extent: 16, not offsets.max()+1 = 12).
+        st_r = MPI.Datatype.Create_struct(
+            [2], [0], [MPI.INT.Create_resized(0, 8)])
+        assert sorted(set(st_r._offsets // 4)) == [0, 2]
+        assert st_r.Get_extent() == (0, 16)
+        # A freed component must be rejected, like every other use of
+        # a freed datatype.
+        vec2 = MPI.DOUBLE.Create_vector(2, 1, 3)
+        vec2.Free()
         try:
-            MPI.Datatype.Create_struct([1], [0], [vec])
+            MPI.Datatype.Create_struct([1], [0], [vec2])
         except api.MpiError as exc:
-            assert "named basics" in str(exc)
+            assert "freed" in str(exc).lower()
         else:
-            raise AssertionError("derived component accepted")
-        # A RESIZED basic is a derived layout too: accepting it would
-        # silently build a different record layout than mpi4py's.
-        try:
-            MPI.Datatype.Create_struct(
-                [2], [0], [MPI.INT.Create_resized(0, 8)])
-        except api.MpiError as exc:
-            assert "named basics" in str(exc)
-        else:
-            raise AssertionError("resized struct component accepted")
+            raise AssertionError("freed component accepted")
         # Resized: nonzero lb, zero extent, and non-itemsize-multiple
         # extents rejected.
         st = MPI.Datatype.Create_struct([1], [0], [MPI.INT])
@@ -1916,6 +1923,64 @@ class TestCreateStruct:
 
         res = run_spmd(main, n=2)
         assert res[1] == ([1, 0, 3, 0], [0.5, 0.0, 2.5, 0.0])
+
+    def test_struct_of_derived_roundtrip(self):
+        """Struct with a VECTOR component (round 5): a record holding
+        an int32 tag plus every-other element of a float64 row —
+        packed on rank 0, scattered back through the same layout on
+        rank 1, exactly as mpi4py lays it out."""
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            # component 1: one int32 at byte 0
+            # component 2: vector of 3 float64 taken every 2nd slot,
+            #              starting at byte 8
+            vec = MPI.DOUBLE.Create_vector(3, 1, 2)
+            st = MPI.Datatype.Create_struct(
+                [1, 1], [0, 8], [MPI.INT, vec]).Commit()
+            nbytes = 8 + 5 * 8     # int+pad, then slots 0,2,4 of 5
+            if r == 0:
+                buf = np.zeros(nbytes, np.uint8)
+                buf[:4].view(np.int32)[0] = 77
+                row = buf[8:].view(np.float64)
+                row[:] = [10.0, -1.0, 20.0, -1.0, 30.0]
+                comm.Send([buf, 1, st], dest=1, tag=9)
+                out = None
+            else:
+                got = np.zeros(nbytes, np.uint8)
+                comm.Recv([got, 1, st], source=0, tag=9)
+                row = got[8:].view(np.float64)
+                out = (int(got[:4].view(np.int32)[0]),
+                       row.tolist())
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        tag, row = res[1]
+        assert tag == 77
+        # The -1.0 gap slots never travel: they stay zero.
+        assert row == [10.0, 0.0, 20.0, 0.0, 30.0]
+
+    def test_struct_of_struct_roundtrip(self):
+        """Nested struct component: the inner record's byte layout
+        (with its alignment hole) embeds at the outer displacement."""
+        from mpi_tpu.compat import MPI
+
+        inner = MPI.Datatype.Create_struct(
+            [1, 1], [0, 4], [MPI.INT, MPI.FLOAT])   # 8-byte record
+        outer = MPI.Datatype.Create_struct(
+            [1, 2], [0, 8], [MPI.DOUBLE, inner]).Commit()
+        # outer: double at 0; two inner records at 8 and 16.
+        src = np.zeros(24, np.uint8)
+        src[:8].view(np.float64)[0] = 1.5
+        src[8:12].view(np.int32)[0] = 7
+        src[12:16].view(np.float32)[0] = 0.25
+        src[16:20].view(np.int32)[0] = 8
+        src[20:24].view(np.float32)[0] = 0.75
+        wire = outer._pack(src, 1, "test")
+        dst = np.zeros(24, np.uint8)
+        outer._unpack(dst, wire, 1, "test")
+        np.testing.assert_array_equal(dst, src)
 
     def test_overlapping_resized_receive_rejected(self):
         """Shrinking the extent below the layout span makes items
