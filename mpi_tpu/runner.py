@@ -72,6 +72,31 @@ def run_main(main: Callable[[], Any],
                 f"mpi_tpu: --{FLAG_RANKS} must be an integer, "
                 f"got {ranks_s!r}") from exc
 
+    if backend in ("xla", "hybrid") \
+            and os.environ.get("JAX_PLATFORMS"):
+        # Honor the documented env-var spelling RELIABLY: with a TPU
+        # PJRT plugin pre-registered at interpreter startup, the env
+        # var alone loses and the first device query walks to the
+        # plugin (observed: a dead device tunnel hangs the program in
+        # C before main() runs). Pinning via jax.config before any
+        # device query is the working form. The full comma list passes
+        # through (JAX's own fallback semantics), and when cpu leads
+        # it, --mpi-ranks sizes the virtual device mesh too — so
+        # `JAX_PLATFORMS=cpu prog --mpi-backend xla --mpi-ranks 8`
+        # works with no XLA_FLAGS incantation.
+        from .utils.platform import force_platform
+
+        platforms = os.environ["JAX_PLATFORMS"]
+        n = ranks()
+        cpu_n = n if platforms.split(",")[0] == "cpu" else None
+        if not force_platform(platforms, num_cpu_devices=cpu_n):
+            import warnings
+
+            warnings.warn(
+                "mpi_tpu: JAX_PLATFORMS is set but a JAX backend is "
+                "already initialized — the platform pin was skipped "
+                "and device queries will use the live backend",
+                RuntimeWarning, stacklevel=2)
     if backend == "xla":
         from .backends.xla import run_spmd
 
