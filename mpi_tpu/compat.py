@@ -249,6 +249,25 @@ class Request:
         return idx, flag
 
     @classmethod
+    def Testsome(cls, requests: List["Request"]):
+        """Nonblocking :meth:`Waitsome`: (indices, results) of every
+        request complete RIGHT NOW (all consumed: slots become None);
+        ``([], [])`` when active requests exist but none is ready;
+        ``(None, None)`` when every slot is already null
+        (MPI_UNDEFINED case)."""
+        if all(r is None for r in requests):
+            return None, None
+        indices, results = [], []
+        for i, r in enumerate(requests):
+            if r is not None and r.test():
+                results.append(r.wait())
+                indices.append(i)
+                requests[i] = None
+        return indices, results
+
+    testsome = Testsome
+
+    @classmethod
     def Waitsome(cls, requests: List["Request"]):
         """Block until at least one request completes; returns
         (indices, results) for EVERY request complete at that moment
@@ -368,6 +387,56 @@ class Prequest(Request):
         return not self._p.active
 
     test = Test
+
+
+# Outstanding buffered sends (MPI_Bsend family): the payload is
+# detached at the call, but MPI_Finalize must not tear the transport
+# from under a rendezvous still waiting for its receiver — Finalize
+# drains this registry first. Completed entries are swept
+# opportunistically on each new bsend so a long-running rank doesn't
+# accumulate request objects.
+_pending_bsends: List["api.Request"] = []
+_pending_bsends_lock = _threading.Lock()
+
+
+def _track_bsend(req: "api.Request") -> "api.Request":
+    with _pending_bsends_lock:
+        done = [r for r in _pending_bsends if r.test()]
+        _pending_bsends[:] = [r for r in _pending_bsends
+                              if not r.test()]
+        _pending_bsends.append(req)
+    for r in done:
+        if r._exc is not None:  # surface, don't silently drop the msg
+            import warnings as _warnings
+
+            _warnings.warn(
+                f"mpi_tpu: a buffered send failed: "
+                f"{type(r._exc).__name__}: {r._exc}",
+                RuntimeWarning, stacklevel=3)
+    return req
+
+
+def _drain_bsends(timeout: float = 30.0) -> None:
+    import time as _time
+    import warnings as _warnings
+
+    with _pending_bsends_lock:
+        pending = list(_pending_bsends)
+        _pending_bsends.clear()
+    # One SHARED deadline across the set: N undeliverable sends must
+    # stall Finalize for ~timeout total, not N * timeout.
+    deadline = _time.monotonic() + timeout
+    for r in pending:
+        try:
+            r.wait(max(0.05, deadline - _time.monotonic()))
+        except Exception as exc:  # noqa: BLE001 - finalize proceeds
+            # A buffered send's error has nowhere else to surface
+            # (nobody waits the request) — say so instead of silently
+            # dropping the message.
+            _warnings.warn(
+                f"mpi_tpu: a buffered send could not complete before "
+                f"finalize: {type(exc).__name__}: {exc}",
+                RuntimeWarning, stacklevel=2)
 
 
 class _GrequestInner:
@@ -524,6 +593,17 @@ class Comm:
     rank = property(Get_rank)
     size = property(Get_size)
 
+    def Is_inter(self) -> bool:
+        """False: this is an intracommunicator (MPI_Comm_test_inter);
+        :class:`Intercomm` answers True."""
+        return False
+
+    def Is_intra(self) -> bool:
+        return not self.Is_inter()
+
+    is_inter = property(Is_inter)
+    is_intra = property(Is_intra)
+
     @property
     def native(self) -> _NativeComm:
         """The underlying :class:`mpi_tpu.comm.Comm` (escape hatch)."""
@@ -581,6 +661,40 @@ class Comm:
 
     def isend(self, obj: Any, dest: int, tag: int = 0) -> Request:
         return Request(self._c.isend(obj, dest, tag))
+
+    # Send MODES (MPI_Ssend / MPI_Bsend families). The base send IS
+    # synchronous here (rendezvous: it returns only once the receive
+    # accepted — network.go:569 parity), so the S-forms alias it
+    # honestly. The B-forms provide real BUFFERED semantics: the
+    # payload is detached (deep-copied) immediately and the rendezvous
+    # completes on a background worker, so the caller returns at once
+    # and may reuse its buffer — code relying on MPI_Bsend's local
+    # completion to avoid head-to-head deadlocks works unchanged
+    # (buffering is automatic; no Attach_buffer needed).
+
+    ssend = send
+    issend = isend
+
+    def bsend(self, obj: Any, dest: int, tag: int = 0) -> None:
+        import copy as _copy
+
+        # Eager envelope validation: the background worker defers
+        # _check_peer, and an unwaited buffered send would otherwise
+        # swallow even an invalid destination silently.
+        self._c._check_peer(dest)
+        _track_bsend(self._c.isend(_copy.deepcopy(obj), dest, tag))
+
+    def ibsend(self, obj: Any, dest: int, tag: int = 0) -> Request:
+        """Buffered isend: returns a request that completes when the
+        detached payload has been delivered (waiting it is optional —
+        MPI says a buffered send's completion never depends on a
+        matching receive having started, and the copy already
+        happened)."""
+        import copy as _copy
+
+        self._c._check_peer(dest)
+        return Request(_track_bsend(
+            self._c.isend(_copy.deepcopy(obj), dest, tag)))
 
     def irecv(self, source: int = -1, tag: int = 0) -> Request:
         _check_tag_not_wild(tag, "irecv")
@@ -723,6 +837,24 @@ class Comm:
     def Isend(self, buf: Any, dest: int, tag: int = 0) -> Request:
         return Request(self._c.isend(_spec_payload(buf, "Isend"),
                                      dest, tag))
+
+    # Buffer-form send modes (see the object-form block above for the
+    # semantics: S-forms alias the already-synchronous send; B-forms
+    # snapshot the packed payload and complete in the background).
+    Ssend = Send
+    Issend = Isend
+
+    def Bsend(self, buf: Any, dest: int, tag: int = 0) -> None:
+        self._c._check_peer(dest)
+        payload = _spec_payload(buf, "Bsend")
+        _track_bsend(self._c.isend(np.array(payload, copy=True),
+                                   dest, tag))
+
+    def Ibsend(self, buf: Any, dest: int, tag: int = 0) -> Request:
+        self._c._check_peer(dest)
+        payload = _spec_payload(buf, "Ibsend")
+        return Request(_track_bsend(
+            self._c.isend(np.array(payload, copy=True), dest, tag)))
 
     def Irecv(self, buf: Any, source: int = -1, tag: int = 0) -> Request:
         """Nonblocking buffer receive: the buffer fills when the
@@ -1571,6 +1703,16 @@ class Intercomm:
     def __init__(self, native):
         self._c = native
 
+    def Is_inter(self) -> bool:
+        """True (MPI_Comm_test_inter)."""
+        return True
+
+    def Is_intra(self) -> bool:
+        return False
+
+    is_inter = property(Is_inter)
+    is_intra = property(Is_intra)
+
     @property
     def native(self):
         return self._c
@@ -1635,6 +1777,23 @@ class Intercomm:
         self._c.barrier()
 
     Barrier = barrier
+
+    # Send modes (same contracts as Comm's: the base send is already
+    # synchronous; the B-forms detach the payload and are drained by
+    # MPI.Finalize). dest addresses a REMOTE rank, like every
+    # intercomm p2p call.
+    ssend = send
+
+    def bsend(self, obj: Any, dest: int, tag: int = 0) -> None:
+        import copy as _copy
+
+        _track_bsend(self._c.isend(_copy.deepcopy(obj), dest, tag))
+
+    def ibsend(self, obj: Any, dest: int, tag: int = 0) -> Request:
+        import copy as _copy
+
+        return Request(_track_bsend(
+            self._c.isend(_copy.deepcopy(obj), dest, tag)))
 
     def Free(self) -> None:
         """Release the intercomm's private union communicator
@@ -3229,6 +3388,10 @@ class _MPI:
             _spawn.get_parent()
 
     def Finalize(self) -> None:
+        # MPI_Finalize must complete pending communication: buffered
+        # sends whose receivers haven't matched yet get their drain
+        # window here, instead of dying with the transport.
+        _drain_bsends()
         if self.Is_initialized():
             api.finalize()
         self._world_cache = None

@@ -166,6 +166,106 @@ class TestRequestCancel:
         assert res[0] == ("payload", False)
 
 
+class TestSendModes:
+    def test_bsend_returns_before_receiver_posts(self):
+        """MPI_Bsend's deadlock-avoidance property: BOTH ranks bsend
+        to each other first and only then receive — with the
+        rendezvous (synchronous) base send this head-to-head pattern
+        would deadlock; buffered sends detach the payload and return
+        immediately."""
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            peer = 1 - r
+            comm.bsend({"from": r}, dest=peer, tag=2)   # returns NOW
+            got = comm.recv(source=peer, tag=2)
+            # Buffer-form too, same pattern.
+            comm.Bsend(np.full(4, float(r), np.float64), dest=peer,
+                       tag=3)
+            buf = np.zeros(4, np.float64)
+            comm.Recv(buf, source=peer, tag=3)
+            MPI.Finalize()                # drains pending bsends
+            return got["from"], float(buf[0])
+
+        res = run_spmd(main, n=2)
+        assert res == [(1, 1.0), (0, 0.0)]
+
+    def test_bsend_buffer_reuse_is_safe(self):
+        """The payload is detached at the call: mutating the buffer
+        right after Bsend must not corrupt what the receiver gets."""
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            if r == 0:
+                buf = np.arange(8, dtype=np.int64)
+                comm.Bsend(buf, dest=1, tag=5)
+                buf[:] = -1          # reuse immediately
+                comm.barrier()
+                out = None
+            else:
+                comm.barrier()       # receive only AFTER the mutation
+                got = np.zeros(8, np.int64)
+                comm.Recv(got, source=0, tag=5)
+                out = got.tolist()
+            MPI.Finalize()
+            return out
+
+        res = run_spmd(main, n=2)
+        assert res[1] == list(range(8))
+
+    def test_bsend_invalid_rank_raises_eagerly(self):
+        """A never-waited buffered send must not swallow an invalid
+        destination: the envelope validates at the call."""
+        def main():
+            MPI, comm = _world()
+            try:
+                comm.bsend("x", dest=comm.Get_size() + 3, tag=0)
+            except MPI.Exception:
+                ok = True
+            else:
+                ok = False
+            comm.barrier()
+            MPI.Finalize()
+            return ok
+
+        assert all(run_spmd(main, n=2))
+
+    def test_ssend_aliases_are_synchronous_send(self):
+        from mpi_tpu.compat import MPI
+
+        assert MPI.Comm.ssend is MPI.Comm.send
+        assert MPI.Comm.Ssend is MPI.Comm.Send
+        assert MPI.Comm.issend is MPI.Comm.isend
+        assert MPI.Comm.Issend is MPI.Comm.Isend
+
+    def test_testsome(self):
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            g = MPI.Grequest.Start()
+            reqs = [g]
+            idx, res = MPI.Request.Testsome(reqs)
+            assert (idx, res) == ([], [])      # active, none ready
+            g.Complete()
+            idx, res = MPI.Request.Testsome(reqs)
+            assert idx == [0] and reqs[0] is None
+            assert MPI.Request.Testsome(reqs) == (None, None)
+            comm.barrier()
+            MPI.Finalize()
+            return True
+
+        assert all(run_spmd(main, n=2))
+
+    def test_is_inter(self):
+        def main():
+            MPI, comm = _world()
+            flags = (comm.Is_inter(), comm.Is_intra())
+            MPI.Finalize()
+            return flags
+
+        assert run_spmd(main, n=2) == [(False, True)] * 2
+
+
 class TestPackExternal:
     def test_roundtrip_and_big_endian_on_wire(self):
         from mpi_tpu.compat import MPI
