@@ -254,13 +254,23 @@ class Request:
         request complete RIGHT NOW (all consumed: slots become None);
         ``([], [])`` when active requests exist but none is ready;
         ``(None, None)`` when every slot is already null
-        (MPI_UNDEFINED case)."""
+        (MPI_UNDEFINED case). Like ``Waitall``'s documented contract,
+        a completed-with-error request re-raises its error (its slot
+        is consumed; results collected before it are carried on the
+        exception as ``exc.partial = (indices, results)`` so a drain
+        loop can keep the delivered payloads)."""
         if all(r is None for r in requests):
             return None, None
         indices, results = [], []
         for i, r in enumerate(requests):
             if r is not None and r.test():
-                results.append(r.wait())
+                try:
+                    result = r.wait()
+                except Exception as exc:
+                    requests[i] = None     # complete, just failed
+                    exc.partial = (indices, results)
+                    raise
+                results.append(result)
                 indices.append(i)
                 requests[i] = None
         return indices, results
@@ -401,10 +411,14 @@ _pending_bsends_lock = _threading.Lock()
 
 def _track_bsend(req: "api.Request") -> "api.Request":
     with _pending_bsends_lock:
-        done = [r for r in _pending_bsends if r.test()]
-        _pending_bsends[:] = [r for r in _pending_bsends
-                              if not r.test()]
-        _pending_bsends.append(req)
+        # ONE test() per request: a second pass could see a request
+        # complete in between and purge it without ever reaching the
+        # error warning below.
+        done, still = [], []
+        for r in _pending_bsends:
+            (done if r.test() else still).append(r)
+        still.append(req)
+        _pending_bsends[:] = still
     for r in done:
         if r._exc is not None:  # surface, don't silently drop the msg
             import warnings as _warnings
@@ -424,11 +438,19 @@ def _drain_bsends(timeout: float = 30.0) -> None:
         pending = list(_pending_bsends)
         _pending_bsends.clear()
     # One SHARED deadline across the set: N undeliverable sends must
-    # stall Finalize for ~timeout total, not N * timeout.
+    # stall Finalize for ~timeout total, not N * timeout — once the
+    # deadline passes, the remainder is abandoned with one warning.
     deadline = _time.monotonic() + timeout
-    for r in pending:
+    for i, r in enumerate(pending):
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            _warnings.warn(
+                f"mpi_tpu: {len(pending) - i} buffered send(s) still "
+                f"undelivered after the {timeout:.0f}s finalize drain "
+                f"window", RuntimeWarning, stacklevel=2)
+            break
         try:
-            r.wait(max(0.05, deadline - _time.monotonic()))
+            r.wait(remaining)
         except Exception as exc:  # noqa: BLE001 - finalize proceeds
             # A buffered send's error has nowhere else to surface
             # (nobody waits the request) — say so instead of silently
@@ -1781,17 +1803,20 @@ class Intercomm:
     # Send modes (same contracts as Comm's: the base send is already
     # synchronous; the B-forms detach the payload and are drained by
     # MPI.Finalize). dest addresses a REMOTE rank, like every
-    # intercomm p2p call.
+    # intercomm p2p call; the envelope validates EAGERLY — an
+    # unwaited buffered send must not swallow an invalid remote rank.
     ssend = send
 
     def bsend(self, obj: Any, dest: int, tag: int = 0) -> None:
         import copy as _copy
 
+        self._c._remote_to_union(dest)
         _track_bsend(self._c.isend(_copy.deepcopy(obj), dest, tag))
 
     def ibsend(self, obj: Any, dest: int, tag: int = 0) -> Request:
         import copy as _copy
 
+        self._c._remote_to_union(dest)
         return Request(_track_bsend(
             self._c.isend(_copy.deepcopy(obj), dest, tag)))
 
@@ -3300,6 +3325,21 @@ class _MPI:
     Exception = api.MpiError
     SUCCESS = _errclass.SUCCESS
     ERR_LASTCODE = _errclass.ERR_LASTCODE
+
+    @staticmethod
+    def Attach_buffer(buf: Any) -> None:
+        """Accepted for mpi4py source compatibility and ignored:
+        buffered sends here detach their payload automatically (each
+        ``bsend`` deep-copies at the call), so no user-provided
+        staging buffer exists to attach — the argument's size never
+        limits anything."""
+
+    @staticmethod
+    def Detach_buffer() -> None:
+        """Inverse shim of :meth:`Attach_buffer`: waits out any
+        outstanding buffered sends (MPI_Buffer_detach's blocking
+        contract) and returns None."""
+        _drain_bsends()
 
     @staticmethod
     def Get_error_class(errorcode: int) -> int:
