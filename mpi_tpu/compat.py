@@ -2161,6 +2161,26 @@ class File:
     def Write_at(self, offset: int, buf: Any) -> None:
         self._f.write_at(int(offset), np.ascontiguousarray(buf))
 
+    def Iread_at(self, offset: int, buf: Any) -> Request:
+        """Nonblocking :meth:`Read_at` (``MPI_File_iread_at``): the
+        buffer fills when the returned request completes. Independent
+        (non-collective), like the blocking form, whose fill logic it
+        delegates to (the buffer validates eagerly so a bad target
+        raises here, not on the worker)."""
+        _writable_buffer(buf, "File.Iread_at")
+        return Request(api.Request(
+            lambda: self.Read_at(int(offset), buf)))
+
+    def Iwrite_at(self, offset: int, buf: Any) -> Request:
+        """Nonblocking :meth:`Write_at` (``MPI_File_iwrite_at``). The
+        payload is snapshotted at the call (ONE copy, contiguous), so
+        the caller may reuse its buffer immediately (MPI permits
+        either; the copy is the safe contract for a fire-and-forget
+        request)."""
+        data = np.array(buf, copy=True, order="C")
+        return Request(api.Request(
+            lambda: self.Write_at(int(offset), data)))
+
     def Read_at_all(self, offset: int, buf: Any,
                     status: Any = None) -> None:
         out = _writable_buffer(buf, "File.Read_at_all")

@@ -305,6 +305,35 @@ class TestPackExternal:
             MPI.DOUBLE.Pack_external_size("native", 1)
 
 
+class TestNonblockingIO:
+    def test_iwrite_iread_roundtrip(self, tmp_path):
+        """MPI_File_iwrite_at / iread_at: requests complete the IO;
+        the write payload is snapshotted (buffer reuse is safe)."""
+        path = str(tmp_path / "nbio.bin")
+
+        def main():
+            MPI, comm = _world()
+            r = comm.Get_rank()
+            f = MPI.File.Open(comm, path,
+                              MPI.MODE_CREATE | MPI.MODE_RDWR)
+            src = np.full(8, float(r), np.float64)
+            req = f.Iwrite_at(r * 64, src)
+            src[:] = -1.0                    # reuse immediately
+            req.wait()
+            comm.barrier()                   # all writes visible
+            got = np.zeros(8, np.float64)
+            peer = (r + 1) % comm.Get_size()
+            rreq = f.Iread_at(peer * 64, got)
+            rreq.wait()
+            comm.barrier()
+            f.Close()
+            MPI.Finalize()
+            return float(got[0])
+
+        res = run_spmd(main, n=2)
+        assert res == [1.0, 0.0]
+
+
 class TestIneighbor:
     def test_ineighbor_alltoall_matches_blocking(self):
         def main():
