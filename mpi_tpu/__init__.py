@@ -98,7 +98,45 @@ from .api import (
 
 __version__ = "0.1.0"
 
+# Fault-surface exports resolve lazily (PEP 562): a chaos-less run
+# never imports the chaos module — matching the TCP driver's init-time
+# deferral and the flag layer's raw-string pass-through — and the typed
+# fault errors (docs/FAULT_TOLERANCE.md) are catchable from the package
+# top level without reaching into backend internals.
+_CHAOS_EXPORTS = ("ChaosNetwork", "ChaosEngine", "ChaosConfig",
+                  "parse_chaos")
+_LAZY_EXPORTS = {
+    **{name: "chaos" for name in _CHAOS_EXPORTS},
+    "ChecksumError": "backends.tcp",
+    "PeerDeadError": "backends.tcp",
+    "RemoteAbortError": "backends.tcp",
+    "DeadlineError": "backends.rendezvous",
+}
+
+
+def __getattr__(name):
+    modname = _LAZY_EXPORTS.get(name)
+    if modname is not None:
+        import importlib
+
+        return getattr(
+            importlib.import_module(f".{modname}", __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))
+
+
 __all__ = [
+    "ChaosNetwork",
+    "ChaosEngine",
+    "ChaosConfig",
+    "parse_chaos",
+    "ChecksumError",
+    "PeerDeadError",
+    "RemoteAbortError",
+    "DeadlineError",
     "Comm",
     "CartComm",
     "Window",

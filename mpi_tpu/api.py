@@ -330,6 +330,14 @@ def _dispatch_error(exc: MpiError) -> None:
         _tb.print_exception(type(exc), exc, exc.__traceback__,
                             file=_sys.stderr)
         print("mpi_tpu: aborting (errhandler=fatal)", file=_sys.stderr)
+        # MPI_ERRORS_ARE_FATAL aborts the JOB: propagate before exiting
+        # so peers raise instead of hanging until their deadlines.
+        try:
+            notify = getattr(registered(), "notify_abort", None)
+            if notify is not None:
+                notify(13)
+        except BaseException:  # noqa: BLE001 - exiting anyway
+            pass
         os._exit(13)
     if callable(handler):
         handler(exc)
@@ -622,6 +630,14 @@ def abort(code: int = 1) -> None:
     print(f"mpi_tpu: abort({code})", file=_sys.stderr)
     try:
         impl = registered()
+        # Failure propagation (docs/FAULT_TOLERANCE.md): drivers with an
+        # ABORT control frame tell every peer first, so remote ranks
+        # raise a typed RemoteAbortError on their pending/future ops
+        # instead of discovering the death via connection errors or
+        # deadlines.
+        notify = getattr(impl, "notify_abort", None)
+        if notify is not None:
+            notify(code)
         impl.finalize()
     except BaseException:  # noqa: BLE001 - exiting anyway
         pass

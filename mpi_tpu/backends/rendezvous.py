@@ -10,16 +10,34 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 from ..api import MpiError, TagError
 
-__all__ = ["Cancel", "ReceiveCancelled", "TagManager", "Rendezvous"]
+__all__ = ["Cancel", "DeadlineError", "ReceiveCancelled", "TagManager",
+           "Rendezvous"]
 
 
 class ReceiveCancelled(MpiError):
     """A pending receive was cancelled via ``cancel_receive`` (used by
     :func:`mpi_tpu.api.exchange` to clean up after a failed send)."""
+
+
+class DeadlineError(MpiError):
+    """A blocking operation exceeded the ``--mpi-optimeout`` deadline.
+
+    MPI class ``ERR_PENDING``: the operation did not complete — the peer
+    is presumed dead or wedged. After a deadline expires the ``{peer,
+    tag}`` channel is indeterminate (a late ack/payload may still arrive
+    and be mis-matched to a later claim of the same tag); callers should
+    treat the peer as failed rather than retry on the same tag."""
+
+    def __init__(self, op: str, timeout: float):
+        super().__init__(
+            f"mpi_tpu: {op} exceeded the {timeout:g}s operation deadline "
+            f"(--mpi-optimeout); peer presumed dead or wedged "
+            f"(MPI_ERR_PENDING)")
 
 
 class Cancel:
@@ -50,10 +68,18 @@ class TagManager:
 
     def claim(self, tag: int) -> Tuple[queue.Queue, int]:
         """Register a live caller-side use of ``tag`` (send or receive).
-        Returns the slot and this claim's generation."""
+        Returns the slot and this claim's generation.
+
+        A poisoned direction still honors already-buffered traffic for
+        the tag: a payload routed before the death is deliverable, and a
+        routed per-tag failure (e.g. the ChecksumError for the exact
+        frame that killed the conn) is more attributable than the
+        generic poison — wait() drains the slot either way."""
         with self._lock:
             if self._dead is not None:
-                raise self._dead
+                q = self._slots.get(tag)
+                if q is None or q.empty():
+                    raise self._dead
             if tag in self._claimed:
                 raise TagError(tag, self._peer, self._direction)
             self._claimed.add(tag)
@@ -110,18 +136,46 @@ class TagManager:
         q.put(item)
 
     def poison(self, exc: BaseException) -> None:
-        """Fail all pending and future operations on this direction."""
+        """Fail all pending and future operations on this direction.
+
+        First poison wins: a second reader dying of the cross-close
+        fallout must not overwrite the original (more attributable)
+        cause of death."""
         with self._lock:
-            self._dead = exc
+            if self._dead is None:
+                self._dead = exc
+            else:
+                exc = self._dead
             slots = list(self._slots.values())
         for q in slots:
             q.put(exc)
 
-    def wait(self, slot: queue.Queue, gen: int) -> Any:
+    def wait(self, slot: queue.Queue, gen: int,
+             timeout: Optional[float] = None,
+             op: str = "operation") -> Any:
         """Block on ``slot`` for data, handling cancellation tokens and
-        routed exceptions. Returns the payload."""
+        routed exceptions. Returns the payload.
+
+        With ``timeout`` (seconds — the ``--mpi-optimeout`` plumbing) a
+        slot that stays empty past the deadline raises
+        :class:`DeadlineError` instead of blocking forever; ``op`` names
+        the operation in the error message."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            item = slot.get()
+            try:
+                if deadline is None:
+                    item = slot.get()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        # Deadline lapsed — but an already-delivered item
+                        # (payload behind a just-drained stale Cancel,
+                        # or timeout=0) must still win over the error.
+                        item = slot.get_nowait()
+                    else:
+                        item = slot.get(timeout=remaining)
+            except queue.Empty:
+                raise DeadlineError(op, timeout) from None
             if isinstance(item, Cancel):
                 if item.gen == gen:
                     raise item.exc
@@ -199,14 +253,42 @@ class Rendezvous:
             ent = self._entries.get(tag)
             return ent is not None and ent.creator == self._SENDER
 
-    def send(self, tag: int, payload: Any) -> None:
+    def send(self, tag: int, payload: Any,
+             timeout: Optional[float] = None, op: str = "send") -> None:
         ent = self._entry(tag, self._SENDER)
-        ent.q.put(payload)
-        ent.done.wait()  # rendezvous: return only after receiver took it
+        try:
+            if timeout is None:
+                ent.q.put(payload)
+            else:
+                # The maxsize-1 queue can already hold the payload of a
+                # sender whose receiver deadlined mid-engagement; the
+                # put must be bounded too or the deadline is defeated.
+                ent.q.put(payload, timeout=timeout)
+        except queue.Full:
+            raise DeadlineError(op, timeout) from None
+        # Rendezvous: return only after the receiver took it. With
+        # ``timeout`` (--mpi-optimeout parity with the remote path) a
+        # receiver that never shows raises DeadlineError; the parked
+        # payload then leaves the tag indeterminate, as documented for
+        # the remote deadline.
+        if not ent.done.wait(timeout):
+            raise DeadlineError(op, timeout)
 
-    def receive(self, tag: int) -> Any:
+    def receive(self, tag: int,
+                timeout: Optional[float] = None, op: str = "receive") -> Any:
         ent = self._entry(tag, self._RECEIVER)
-        payload = ent.q.get()
+        try:
+            payload = (ent.q.get() if timeout is None
+                       else ent.q.get(timeout=timeout))
+        except queue.Empty:
+            # Retire the still-unengaged entry so a later sender parks
+            # on a fresh rendezvous instead of filling this corpse; a
+            # sender that engaged in the race keeps the entry (its own
+            # deadline bounds it).
+            with self._lock:
+                if self._entries.get(tag) is ent and not ent.sender_engaged:
+                    self._entries.pop(tag)
+            raise DeadlineError(op, timeout) from None
         if isinstance(payload, Cancel):
             raise payload.exc
         # The receiver retires the entry *before* signalling the sender:

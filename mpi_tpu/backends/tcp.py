@@ -40,12 +40,26 @@ which change the documented contracts:
 
 Wire protocol (replaces gob; all integers little-endian)::
 
-    frame      := kind:u8  tag:i64  length:u32  payload[length]
+    frame      := kind:u8  tag:i64  length:u32  payload[length]  [crc:u32]
     kind       := 0 DATA   payload = mpi_tpu.utils.serialize codec bytes
                   1 ACK    payload = empty (length 0)
-                  2 HELLO  payload = utf-8 password; tag field carries the
+                  2 HELLO  payload = utf-8 password, optionally followed
+                           by "\\0mpi-feat:" and a comma-separated feature
+                           list (see below); tag field carries the
                            sender's claimed rank id (initialMessage
                            {Password, Id}, network.go:198-201)
+                  3 ABORT  payload = empty; tag field carries the abort
+                           exit code (failure-propagation control frame,
+                           docs/FAULT_TOLERANCE.md — no reference
+                           analogue, the reference can only hang)
+
+Integrity (``--mpi-crc``): each side advertises the ``crc32`` feature in
+its HELLO; when **both** ends of a connection advertise it, every DATA
+frame on that connection carries a CRC32 trailer over header+payload.
+Off (the default, or a peer without the feature) the wire is bit-for-bit
+today's format and the zero-copy native fast path is untouched; on, a
+corrupted frame raises a typed ``ERR_TRUNCATE``-class error naming the
+source rank and tag instead of a garbage decode.
 """
 
 from __future__ import annotations
@@ -55,6 +69,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import flags as flagmod
@@ -62,17 +77,28 @@ from ..api import MpiError
 from ..utils.serialize import decode as codec_decode
 from ..utils.serialize import encode as codec_encode
 from ..utils.serialize import encode_parts as codec_encode_parts
-from .rendezvous import ReceiveCancelled, Rendezvous, TagManager
+from .rendezvous import (DeadlineError, ReceiveCancelled, Rendezvous,
+                         TagManager)
 from .shm import ShmConn
 
-__all__ = ["TcpNetwork", "InitError", "ReceiveCancelled"]
+__all__ = ["TcpNetwork", "InitError", "ReceiveCancelled", "DeadlineError",
+           "ChecksumError", "PeerDeadError", "RemoteAbortError"]
 
 KIND_DATA = 0
 KIND_ACK = 1
 KIND_HELLO = 2
+KIND_ABORT = 3
 
 _FRAME_HDR = struct.Struct("<BqI")
+_CRC_TRAILER = struct.Struct("<I")
 _DIAL_RETRY_INTERVAL = 0.1  # network.go:298 — 100 ms poll
+
+# HELLO feature negotiation: the password payload may be followed by this
+# separator and a comma-separated feature list. A password that literally
+# contains the separator would misparse — NUL bytes in passwords are
+# rejected at init instead of risking a silent feature mismatch.
+_FEATURE_SEP = b"\x00mpi-feat:"
+_FEATURE_CRC = "crc32"
 
 # The reference's NetProto accepts any `net` package protocol
 # (network.go:26). Supported here: TCP (the default, "tcp4" an alias,
@@ -87,6 +113,52 @@ _SUPPORTED_PROTOS = ("tcp", "tcp4", "tcp6", "unix", "shm")
 class InitError(MpiError):
     """Bootstrap failure; aggregates per-peer handshake errors
     (network.go:185-195, 281-291)."""
+
+
+class ChecksumError(MpiError):
+    """A DATA frame failed its negotiated CRC32 integrity check.
+
+    MPI class ``ERR_TRUNCATE`` (the class an MPI implementation reports
+    when a message's bytes do not match what was sent). Carries the
+    source rank and tag so the failure is attributable."""
+
+    def __init__(self, src: int, tag: int):
+        self.src = src
+        self.tag = tag
+        super().__init__(
+            f"mpi_tpu: frame integrity check failed for message from "
+            f"rank {src} tag {tag}: CRC32 mismatch — payload corrupted "
+            f"in transit (MPI_ERR_TRUNCATE)")
+
+
+class PeerDeadError(MpiError):
+    """A peer's connection was lost; pending and future operations
+    targeting it fail with this instead of hanging (MPI class
+    ``ERR_PENDING`` — the operations did not complete)."""
+
+    def __init__(self, peer: int, cause: BaseException):
+        import re as _re
+
+        self.peer = peer
+        # Strip any (MPI_ERR_XXX) marker the cause carries: this error
+        # classifies as ERR_PENDING, and errclass's marker scan takes
+        # the FIRST marker in the message.
+        cause_text = _re.sub(r"\s*\(MPI_ERR_[A-Z_]+\)", "", str(cause))
+        super().__init__(
+            f"mpi_tpu: peer rank {peer} is dead ({cause_text}); pending "
+            f"and future operations targeting it fail (MPI_ERR_PENDING)")
+
+
+class RemoteAbortError(MpiError):
+    """A remote rank called ``abort()`` — its ABORT control frame
+    arrived; this rank's operations involving any peer now raise."""
+
+    def __init__(self, peer: int, code: int):
+        self.peer = peer
+        self.code = code
+        super().__init__(
+            f"mpi_tpu: rank {peer} aborted the job with code {code} "
+            f"(MPI_ERR_OTHER)")
 
 
 def _split_hostport(addr: str) -> Tuple[str, int]:
@@ -115,14 +187,92 @@ def _view_cptr(view):
     return ctypes.cast(arr, ctypes.c_void_p), arr
 
 
+def _crc32_frame(header: bytes, payload, payload2=None) -> int:
+    """CRC32 over header + payload (+ payload2): the trailer value of an
+    integrity-negotiated DATA frame. Covers the header too, so a
+    corrupted kind/tag/length is also caught (when the length corruption
+    still framed plausibly)."""
+    c = zlib.crc32(header)
+    c = zlib.crc32(payload, c)
+    if payload2 is not None:
+        view = memoryview(payload2)
+        if view.ndim != 1 or view.itemsize != 1:
+            view = view.cast("B")
+        try:
+            c = zlib.crc32(view, c)
+        except BufferError:  # non-contiguous: one copy, rare
+            c = zlib.crc32(bytes(view), c)
+    return c
+
+
+def _chaos_wire_send(sock, lock: threading.Lock, kind: int, tag: int,
+                     payload, payload2, use_crc: bool, fault) -> None:
+    """Chaos-plane frame writer: assembles the full frame (including the
+    CRC trailer when negotiated — computed over the CLEAN bytes, exactly
+    as a real sender would), then applies the injected wire fault so the
+    receiver sees genuine line damage: a flipped payload bit, a frame
+    cut short, or a vanished connection."""
+    body = bytearray(_FRAME_HDR.pack(
+        kind, tag,
+        len(payload) + (0 if payload2 is None else
+                        memoryview(payload2).nbytes)))
+    payload_start = len(body)
+    body += payload
+    if payload2 is not None:
+        body += memoryview(payload2)
+    payload_len = len(body) - payload_start
+    if use_crc:
+        body += _CRC_TRAILER.pack(
+            _crc32_frame(bytes(body[:payload_start]),
+                         bytes(body[payload_start:])))
+    if fault.corrupt_offset is not None and payload_len:
+        at = payload_start + fault.corrupt_offset % payload_len
+        body[at] ^= 1 << (fault.corrupt_bit % 8)
+    with lock:
+        if fault.reset:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            sock.close()
+            return
+        if fault.truncate_at is not None:
+            # A frame cut short desynchronizes the stream permanently,
+            # so the connection dies with it — the mid-frame-death
+            # scenario (peer crashed while writing).
+            cut = fault.truncate_at % max(1, len(body) - 1)
+            try:
+                sock.sendall(bytes(body[:cut]))
+            except OSError:
+                pass
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            sock.close()
+            return
+        sock.sendall(bytes(body))
+
+
 def _send_frame(sock, lock: threading.Lock, kind: int,
                 tag: int, payload: bytes = b"",
-                payload2=None) -> None:
+                payload2=None, crc: bool = False, fault=None) -> None:
     """Write one wire frame. With ``payload2`` (the codec's
     :func:`~mpi_tpu.utils.serialize.encode_parts` view) the frame body
     is ``payload + payload2`` scatter-gathered straight from the
     caller's buffer — the zero-copy ndarray data path; the receiver
-    sees one frame either way."""
+    sees one frame either way.
+
+    ``crc`` appends the negotiated CRC32 trailer to DATA frames (the
+    integrity option takes the Python write path; with it off this
+    function is byte-identical to the pre-CRC implementation).
+    ``fault`` (a :class:`mpi_tpu.chaos.WireFault`) routes the frame
+    through the chaos wire plane instead."""
+    use_crc = crc and kind == KIND_DATA and not isinstance(sock, ShmConn)
+    if fault is not None and fault.any() and not isinstance(sock, ShmConn):
+        _chaos_wire_send(sock, lock, kind, tag, payload, payload2,
+                         use_crc, fault)
+        return
     n2 = 0 if payload2 is None else memoryview(payload2).nbytes
     if isinstance(sock, ShmConn):
         # shm conns frame in the ring engine; the per-conn lock still
@@ -142,7 +292,8 @@ def _send_frame(sock, lock: threading.Lock, kind: int,
     # the native engine only speaks blocking sockets (post-handshake data
     # path — handshake frames keep the Python path). Payloads past the
     # u32 wire limit fall through so struct.pack rejects them loudly.
-    lib = _native.wirecore() if sock.gettimeout() is None else None
+    lib = (_native.wirecore()
+           if sock.gettimeout() is None and not use_crc else None)
     if lib is not None and isinstance(payload, bytes) \
             and len(payload) + n2 <= 0xFFFFFFFF:
         # Native path: header + payload (+ array view) leave in one
@@ -177,6 +328,8 @@ def _send_frame(sock, lock: threading.Lock, kind: int,
             return
         raise OSError(-rc, _os.strerror(-rc))
     header = _FRAME_HDR.pack(kind, tag, len(payload) + n2)
+    trailer = (_CRC_TRAILER.pack(_crc32_frame(header, payload, payload2))
+               if use_crc else b"")
     with lock:
         if payload2 is not None:
             # Two sendalls, zero concatenation: sendall accepts the
@@ -185,14 +338,25 @@ def _send_frame(sock, lock: threading.Lock, kind: int,
             # contiguous on the stream.
             sock.sendall(header + payload)
             sock.sendall(payload2)
+            if trailer:
+                sock.sendall(trailer)
         else:
-            sock.sendall(header + payload)
+            sock.sendall(header + payload + trailer)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+def _recv_exact(sock: socket.socket, n: int,
+                midframe: bool = False) -> bytearray:
     """Read exactly ``n`` bytes. Returns the freshly-owned bytearray
     (no defensive copy — the caller is the sole owner, which lets
-    decode() alias large payloads zero-copy)."""
+    decode() alias large payloads zero-copy).
+
+    A ``socket.timeout`` that fires mid-frame — partway through this
+    read, or on a later segment of an already-started frame
+    (``midframe``) — leaves the stream desynchronized: a retry would
+    resume reading from the middle of the frame and decode garbage. It
+    is converted to a fatal :class:`ConnectionError` for this peer; only
+    a timeout on a clean frame boundary surfaces as ``socket.timeout``
+    (the handshake accept/reply deadlines rely on that)."""
     from .. import native as _native
 
     buf = bytearray(n)
@@ -218,18 +382,37 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     view = memoryview(buf)
     got = 0
     while got < n:
-        r = sock.recv_into(view[got:], n - got)
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except socket.timeout:
+            if got or midframe:
+                raise ConnectionError(
+                    f"mpi_tpu: socket timeout mid-frame after {got}/{n} "
+                    f"bytes; stream desynchronized — connection is "
+                    f"unusable") from None
+            raise
         if r == 0:
             raise ConnectionError("connection closed by peer")
         got += r
     return buf
 
 
-def _recv_frame(sock) -> Tuple[int, int, bytearray]:
+def _recv_frame(sock, crc: bool = False,
+                src: int = -1) -> Tuple[int, int, bytearray]:
+    """Read one frame; with ``crc`` (the negotiated integrity option)
+    DATA frames carry a CRC32 trailer, verified here — a mismatch
+    raises :class:`ChecksumError` naming ``src`` and the frame's tag."""
     if isinstance(sock, ShmConn):
         return sock.recv_frame()
-    kind, tag, length = _FRAME_HDR.unpack(_recv_exact(sock, _FRAME_HDR.size))
-    payload = _recv_exact(sock, length) if length else bytearray()
+    header = _recv_exact(sock, _FRAME_HDR.size)
+    kind, tag, length = _FRAME_HDR.unpack(header)
+    payload = (_recv_exact(sock, length, midframe=True) if length
+               else bytearray())
+    if crc and kind == KIND_DATA:
+        trailer = _recv_exact(sock, _CRC_TRAILER.size, midframe=True)
+        if _CRC_TRAILER.unpack(trailer)[0] != \
+                _crc32_frame(bytes(header), payload):
+            raise ChecksumError(src, tag)
     return kind, tag, payload
 
 
@@ -245,6 +428,15 @@ class _Peer:
         self.sendtags = TagManager("send", peer_rank)
         self.receivetags = TagManager("receive", peer_rank)
         self.reader_threads: List[threading.Thread] = []
+        # Negotiated per-connection CRC (both HELLOs advertised crc32).
+        self.dial_crc = False
+        self.listen_crc = False
+        # First failure that killed this peer's connections; set once by
+        # _mark_peer_dead (under dead_lock — both readers can die
+        # concurrently), after which every op targeting the peer fails
+        # fast instead of hanging.
+        self.dead: Optional[BaseException] = None
+        self.dead_lock = threading.Lock()
 
 
 class TcpNetwork:
@@ -258,12 +450,23 @@ class TcpNetwork:
     def __init__(self, proto: Optional[str] = None, addr: Optional[str] = None,
                  addrs: Optional[List[str]] = None,
                  timeout: Optional[float] = None,
-                 password: Optional[str] = None):
+                 password: Optional[str] = None,
+                 optimeout: Optional[float] = None,
+                 crc: Optional[bool] = None,
+                 chaos: Optional[str] = None):
         self.proto = proto
         self.addr = addr
         self.addrs = list(addrs) if addrs else []
         self.timeout = timeout
         self.password = password
+        # Robustness extensions (docs/FAULT_TOLERANCE.md); unset values
+        # resolve from --mpi-optimeout / --mpi-crc / --mpi-chaos at init.
+        self.optimeout = optimeout
+        self.crc = crc
+        # Chaos engine attachment point: a ChaosEngine (or a raw
+        # seed:rate:modes spec string, parsed at init). The send path
+        # consults it per operation; None = fault-free (the default).
+        self._chaos = chaos
 
         self._rank: Optional[int] = None
         self._size: Optional[int] = None
@@ -314,7 +517,14 @@ class TcpNetwork:
         self._initialized = True
 
     def finalize(self) -> None:
-        """Close every connection (network.go:354-369)."""
+        """Close every connection (network.go:354-369).
+
+        Safe to call twice and after a failed ``init()`` (the second
+        call is a no-op; a bootstrap-failure call sees whatever partial
+        state exists) — so error-path cleanup in tests and the chaos
+        harness can ``finalize()`` unconditionally."""
+        if self._closed.is_set():
+            return
         self._closed.set()
         if self._listener is not None:
             try:
@@ -358,42 +568,113 @@ class TcpNetwork:
         (``encode_parts``): the type prefix and the caller's buffer
         leave as one frame with no tobytes/concat copy — measured ~2x
         on 64 MiB one-way sends, where the two encode copies cost 81 ms
-        of a 155 ms transfer."""
+        of a 155 ms transfer.
+
+        With ``--mpi-optimeout`` the ack wait is bounded: a vanished
+        receiver raises :class:`DeadlineError` instead of blocking
+        forever. Under ``--mpi-chaos`` the engine may sleep here (delay
+        modes) or hand back a wire fault applied to this frame."""
         self._check_rank(dest)
+        fault = (self._chaos.on_op("send", dest, tag,
+                                   wire=dest != self._rank)
+                 if self._chaos is not None else None)
         if dest == self._rank:
             # Self path: no tag manager involvement needed beyond the local
             # rendezvous's own misuse detection — and unlike the reference
-            # we do not leak the tag (defect (a), SURVEY.md §2).
-            self._local.send(tag, codec_encode(data))
+            # we do not leak the tag (defect (a), SURVEY.md §2). The
+            # deadline covers it like the remote ack wait.
+            self._local.send(tag, codec_encode(data),
+                             timeout=self.optimeout,
+                             op=f"send(dest={dest}, tag={tag}) self "
+                                f"rendezvous")
             return
         prefix, view = codec_encode_parts(data)
         peer = self._peers[dest]
         ackq, gen = peer.sendtags.claim(tag)
         try:
-            _send_frame(peer.dial_sock, peer.dial_lock, KIND_DATA, tag,
-                        prefix, view)
+            try:
+                _send_frame(peer.dial_sock, peer.dial_lock, KIND_DATA, tag,
+                            prefix, view, crc=peer.dial_crc, fault=fault)
+            except OSError as exc:
+                # The conn died under us (peer crashed; chaos reset by a
+                # sibling thread) before the reader poisoned the tags —
+                # surface the typed peer-death error, not a raw EBADF.
+                raise (peer.dead if peer.dead is not None
+                       else PeerDeadError(peer.rank, exc)) from exc
             # Blocks until the receiver's ack (network.go:569).
-            peer.sendtags.wait(ackq, gen)
+            peer.sendtags.wait(ackq, gen, timeout=self.optimeout,
+                               op=f"send(dest={dest}, tag={tag}) ack wait")
         finally:
             peer.sendtags.release(tag)
 
     def receive(self, source: int, tag: int, out: Optional[Any] = None) -> Any:
-        """Blocking receive (network.go:575-602): dequeue payload, ack, decode."""
+        """Blocking receive (network.go:575-602): dequeue payload, ack, decode.
+
+        With ``--mpi-optimeout`` the payload wait is bounded: a sender
+        that never arrives (peer wedged or dead without a detectable
+        connection loss) raises :class:`DeadlineError`."""
         self._check_rank(source)
+        if self._chaos is not None:
+            self._chaos.on_op("receive", source, tag)
         if source == self._rank:
-            payload = self._local.receive(tag)
+            payload = self._local.receive(
+                tag, timeout=self.optimeout,
+                op=f"receive(source={source}, tag={tag}) self rendezvous")
             return codec_decode(payload, out=out)
         peer = self._peers[source]
         slot, gen = peer.receivetags.claim(tag)
         try:
-            payload = peer.receivetags.wait(slot, gen)
+            payload = peer.receivetags.wait(
+                slot, gen, timeout=self.optimeout,
+                op=f"receive(source={source}, tag={tag})")
             # Ack on the listen conn — this is what unblocks the sender's
             # rendezvous (network.go:617-624); written only now, when the
-            # receive has genuinely accepted the data.
-            _send_frame(peer.listen_sock, peer.listen_lock, KIND_ACK, tag)
+            # receive has genuinely accepted the data. A failed ack write
+            # means the sender died AFTER transmitting: the payload is
+            # fully in hand and the ack has no one left to unblock —
+            # deliver the data rather than discard a completed receive.
+            try:
+                _send_frame(peer.listen_sock, peer.listen_lock, KIND_ACK,
+                            tag)
+            except OSError:
+                pass
         finally:
             peer.receivetags.release(tag)
         return codec_decode(payload, out=out)
+
+    def notify_abort(self, code: int) -> None:
+        """Failure propagation for ``api.abort()``: best-effort ABORT
+        control frame to every live peer on both connections, so remote
+        ranks raise :class:`RemoteAbortError` on their pending and
+        future operations instead of discovering the death by timeout.
+        Never raises — the caller is about to ``os._exit``."""
+        if not self._initialized:
+            return
+        for peer in self._peers.values():
+            if peer.dead is not None:
+                continue
+            for sock, lock in ((peer.dial_sock, peer.dial_lock),
+                               (peer.listen_sock, peer.listen_lock)):
+                if sock is None:
+                    continue
+                try:
+                    if isinstance(sock, ShmConn):
+                        _send_frame(sock, lock, KIND_ABORT, code)
+                        continue
+                    # Timed lock: a sibling thread wedged mid-sendall to
+                    # this (possibly dead) peer must not stall the abort.
+                    # If the lock can't be had, write anyway — worst
+                    # case the interleaved bytes desync the stream and
+                    # the peer sees a connection error, which also ends
+                    # its pending ops.
+                    acquired = lock.acquire(timeout=0.5)
+                    try:
+                        sock.sendall(_FRAME_HDR.pack(KIND_ABORT, code, 0))
+                    finally:
+                        if acquired:
+                            lock.release()
+                except Exception:  # noqa: BLE001 - dying anyway
+                    pass
 
     def cancel_receive(self, source: int, tag: int) -> bool:
         """Best-effort cancellation of a pending receive (no reference
@@ -418,6 +699,30 @@ class TcpNetwork:
         return self._peers[source].receivetags.has_message(tag)
 
     # -- bootstrap ----------------------------------------------------------
+
+    def _hello_payload(self) -> bytes:
+        """HELLO body: the password, plus this side's advertised features
+        when any are enabled. A feature-less HELLO is byte-identical to
+        the pre-negotiation wire format, so with the flag off mixed
+        versions interoperate transparently; mixed *configs* (crc on one
+        side only) negotiate the feature off. Caveat: a peer predating
+        feature negotiation entirely sees an advertising HELLO as a
+        password mismatch — enable ``--mpi-crc`` only when every rank
+        runs a feature-aware build."""
+        pw = self.password.encode("utf-8")
+        if self.crc:
+            return pw + _FEATURE_SEP + _FEATURE_CRC.encode("ascii")
+        return pw
+
+    @staticmethod
+    def _parse_hello(payload) -> Tuple[str, set]:
+        """Split a HELLO body into (password, advertised feature set)."""
+        raw = bytes(payload)
+        if _FEATURE_SEP in raw:
+            pw, _, feats = raw.partition(_FEATURE_SEP)
+            return (pw.decode("utf-8"),
+                    {f for f in feats.decode("utf-8").split(",") if f})
+        return raw.decode("utf-8"), set()
 
     def _is_unix(self) -> bool:
         return self.proto == "unix"
@@ -448,6 +753,24 @@ class TcpNetwork:
                             else flagmod.DEFAULT_INIT_TIMEOUT)
         if self.password is None:
             self.password = fl.password or ""
+        if "\x00" in self.password:
+            raise InitError("mpi_tpu: password must not contain NUL "
+                            "bytes (reserved for HELLO feature "
+                            "negotiation)")
+        if self.optimeout is None:
+            self.optimeout = fl.optimeout  # None = no deadline (default)
+        if self.crc is None:
+            self.crc = bool(fl.crc)
+        # CRC protects byte streams; shm rings are process memory and
+        # frame in the native engine — integrity there is a follow-on.
+        if self._is_shm():
+            self.crc = False
+        if self._chaos is None and fl.chaos:
+            self._chaos = fl.chaos
+        if isinstance(self._chaos, str):
+            from ..chaos import ChaosEngine, parse_chaos
+
+            self._chaos = ChaosEngine(parse_chaos(self._chaos))
 
     def _assign_ranks(self) -> None:
         """Sorted-address consensus (network.go:94-118)."""
@@ -572,15 +895,18 @@ class TcpNetwork:
                 kind, claimed_id, payload = _recv_frame(conn)
                 if kind != KIND_HELLO:
                     raise InitError(f"expected HELLO, got frame kind {kind}")
-                if payload.decode("utf-8") != self.password:
+                their_pw, their_feats = self._parse_hello(payload)
+                if their_pw != self.password:
                     raise InitError("password mismatch")  # network.go:344-347
                 if not 0 <= claimed_id < n or claimed_id == me:
                     raise InitError(f"bad peer id {claimed_id}")  # network.go:348-350
                 lock = threading.Lock()
                 _send_frame(conn, lock, KIND_HELLO, me,
-                            self.password.encode("utf-8"))
+                            self._hello_payload())
                 conn.settimeout(None)
                 peer = self._peers[claimed_id]
+                peer.listen_crc = bool(self.crc) and \
+                    _FEATURE_CRC in their_feats
                 peer.listen_sock = conn
                 peer.listen_lock = lock
             except Exception as exc:  # noqa: BLE001 - aggregated, init fails
@@ -629,18 +955,21 @@ class TcpNetwork:
                 self._tune(sock)
                 lock = threading.Lock()
                 _send_frame(sock, lock, KIND_HELLO, me,
-                            self.password.encode("utf-8"))
+                            self._hello_payload())
                 sock.settimeout(self.timeout)
                 kind, their_id, payload = _recv_frame(sock)
                 if kind != KIND_HELLO:
                     raise InitError(f"expected HELLO reply, got kind {kind}")
-                if payload.decode("utf-8") != self.password:
+                their_pw, their_feats = self._parse_hello(payload)
+                if their_pw != self.password:
                     raise InitError("password mismatch in reply")
                 if their_id != peer_rank:
                     raise InitError(
                         f"dialed rank {peer_rank} but peer claims {their_id}")
                 sock.settimeout(None)
                 peer = self._peers[peer_rank]
+                peer.dial_crc = bool(self.crc) and \
+                    _FEATURE_CRC in their_feats
                 peer.dial_sock = sock
                 peer.dial_lock = lock
             except Exception as exc:  # noqa: BLE001
@@ -697,7 +1026,7 @@ class TcpNetwork:
                 kind, claimed_id, payload = _recv_frame(conn)
                 if kind != KIND_HELLO:
                     raise InitError(f"expected HELLO, got frame kind {kind}")
-                if payload.decode("utf-8") != self.password:
+                if self._parse_hello(payload)[0] != self.password:
                     raise InitError("password mismatch")  # network.go:344-347
                 if claimed_id != peer_rank:
                     raise InitError(
@@ -767,7 +1096,7 @@ class TcpNetwork:
                 kind, their_id, payload = _recv_frame(conn)
                 if kind != KIND_HELLO:
                     raise InitError(f"expected HELLO reply, got kind {kind}")
-                if payload.decode("utf-8") != self.password:
+                if self._parse_hello(payload)[0] != self.password:
                     raise InitError("password mismatch in reply")
                 if their_id != peer_rank:
                     raise InitError(
@@ -801,32 +1130,92 @@ class TcpNetwork:
         try:
             while not self._closed.is_set():
                 kind, tag, _ = _recv_frame(peer.dial_sock)
+                if kind == KIND_ABORT:
+                    raise RemoteAbortError(peer.rank, tag)
                 if kind != KIND_ACK:
                     raise MpiError(f"unexpected frame kind {kind} on dial conn")
                 peer.sendtags.route(tag, True)
+        except RemoteAbortError as exc:
+            self._mark_job_aborted(exc)
         except (ConnectionError, OSError, MpiError) as exc:
-            self._poison(peer.sendtags, exc)
+            self._mark_peer_dead(peer, exc)
 
     def _listen_reader(self, peer: _Peer) -> None:
         """Reads the peer's data frames off my listen conn → routes by tag
         (``receiveReader``, network.go:607-625; ack deferred to receive())."""
         try:
             while not self._closed.is_set():
-                kind, tag, payload = _recv_frame(peer.listen_sock)
+                kind, tag, payload = _recv_frame(peer.listen_sock,
+                                                 crc=peer.listen_crc,
+                                                 src=peer.rank)
+                if kind == KIND_ABORT:
+                    raise RemoteAbortError(peer.rank, tag)
                 if kind != KIND_DATA:
                     raise MpiError(f"unexpected frame kind {kind} on listen conn")
                 peer.receivetags.route(tag, payload)
+        except RemoteAbortError as exc:
+            self._mark_job_aborted(exc)
+        except ChecksumError as exc:
+            # Deliver the integrity failure to the receive it damages
+            # first (so that call raises the attributable ERR_TRUNCATE
+            # error), then retire the connection — after corruption the
+            # framing cannot be trusted. Other pending/future ops on
+            # this peer see peer-death (ERR_PENDING), not a ChecksumError
+            # naming another operation's tag.
+            peer.receivetags.route(exc.tag, exc)
+            self._mark_peer_dead(peer, PeerDeadError(peer.rank, exc))
         except (ConnectionError, OSError, MpiError) as exc:
-            self._poison(peer.receivetags, exc)
+            self._mark_peer_dead(peer, exc)
 
-    def _poison(self, tags: TagManager, exc: BaseException) -> None:
-        """On connection loss, fail all pending *and future* ops on this
-        direction instead of hanging (replaces the reference's reader
-        panics, network.go:555,611): ops already blocked get the exception
-        via their slot; ops issued after the loss fail at claim()."""
+    def _mark_job_aborted(self, exc: "RemoteAbortError") -> None:
+        """A remote rank aborted: the whole job is over, not just one
+        link — every peer's pending and future operations raise the
+        abort error (MPI_Abort terminates the communicator, not an
+        edge). Under ``mpirun`` the launcher reaps this process moments
+        later; in-process harnesses see the typed error instead."""
+        for p in self._peers.values():
+            self._mark_peer_dead(p, exc)
+
+    def _mark_peer_dead(self, peer: _Peer, exc: BaseException) -> None:
+        """On connection loss (either direction's reader died) the whole
+        peer is dead: fail all pending *and future* ops targeting it
+        instead of hanging (replaces the reference's reader panics,
+        network.go:555,611). Ops already blocked get the exception via
+        their slot; ops issued after the loss fail at claim(). Raw
+        socket errors are wrapped in :class:`PeerDeadError` so callers
+        always see a typed, classifiable MpiError."""
         if self._closed.is_set():
             exc = MpiError("mpi_tpu: network finalized")
-        tags.poison(exc)
+        elif not isinstance(exc, MpiError):
+            exc = PeerDeadError(peer.rank, exc)
+        # Poison with the FIRST cause of death: the sibling reader dying
+        # of this call's own cross-close must not rebrand the failure.
+        with peer.dead_lock:
+            if peer.dead is None:
+                peer.dead = exc
+            exc = peer.dead
+        peer.sendtags.poison(exc)
+        peer.receivetags.poison(exc)
+        # Drop both connections: the PEER's readers then observe EOF and
+        # mark us dead too, so its blocked ops (e.g. the ack wait of the
+        # send whose frame failed our CRC check) fail fast instead of
+        # hanging until a deadline that may not be configured. During
+        # finalize the sockets are being closed anyway; re-closing is a
+        # no-op. The sibling reader of this conn pair wakes with a
+        # ConnectionError and re-enters here idempotently.
+        if not self._closed.is_set():
+            for sock in (peer.dial_sock, peer.listen_sock):
+                if sock is None:
+                    continue
+                try:
+                    if not isinstance(sock, ShmConn):
+                        sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
 
     def _check_rank(self, r: int) -> None:
         if self._size is None:

@@ -1,0 +1,297 @@
+"""Deterministic, seeded fault injection for any backend (chaos layer).
+
+No reference analogue: the reference treats every transport failure as a
+panic or an infinite hang (network.go:555,611; SURVEY.md §2). This module
+is the test harness for the opposite stance — failures detected,
+classified, and propagated (docs/FAULT_TOLERANCE.md) — in the spirit of
+MPI Advance's "robustness below a stable API" layering (PAPERS.md).
+
+Two injection planes, one configuration:
+
+  * **Op plane** (any backend): :class:`ChaosNetwork` wraps an
+    :class:`~mpi_tpu.api.Interface` and perturbs each ``send``/``receive``
+    with seeded latency/delivery-delay sleeps and a "rank crashes at op
+    k" kill switch. Delays change *timing only* — a correct transport
+    must produce bit-exact results under them (tests/test_chaos.py).
+
+  * **Wire plane** (TCP driver): the same :class:`ChaosEngine` installs
+    onto ``TcpNetwork._chaos``; the driver consults it per outbound DATA
+    frame and applies payload bit-corruption, frame truncation, and
+    connection resets *after* CRC computation — so a negotiated CRC
+    trailer (``--mpi-crc``) catches the corruption exactly as real line
+    noise would, and truncation/reset exercise the peer-death and
+    ``--mpi-optimeout`` deadline paths.
+
+Configuration grammar (``--mpi-chaos`` / ``MPI_TPU_CHAOS``)::
+
+    spec  := seed ":" rate ":" modes
+    seed  := integer            # RNG seed; same spec ⇒ same fault plan
+    rate  := float in [0, 1]    # per-operation fault probability
+    modes := mode ("," mode)*
+    mode  := "latency"          # sleep ≤ 2 ms before a matched op
+           | "delay"            # sleep ≤ 20 ms before frame delivery
+                                # (reorders completions across threads)
+           | "corrupt"          # flip one payload bit per matched send
+           | "truncate"         # cut a frame short, then drop the conn
+           | "reset"            # drop the connection instead of sending
+           | "crash@K"          # os._exit after K chaos-visible ops
+
+Determinism: every fault decision derives from a BLAKE2 hash of
+``(seed, op, peer, tag, per-channel sequence number)`` — independent of
+thread scheduling, hash randomization, and wall clock — so a failing
+seed replays exactly (``tools/chaos_soak.sh``). The one exception is
+``crash@K``, which by design counts chaos-visible ops in *arrival
+order* ("the rank dies K ops in, whatever they are"): with ops issued
+from multiple threads, which op the death lands on can vary between
+runs of the same seed.
+
+Bootstrap frames (HELLO) never pass through the chaos planes: the fault
+surface starts after ``init()`` returns, so a chaos run always reaches a
+connected state first.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, List, Optional, Tuple
+
+from .api import Interface, MpiError
+
+__all__ = ["ChaosConfig", "ChaosEngine", "ChaosNetwork", "WireFault",
+           "parse_chaos", "CRASH_EXIT_CODE"]
+
+# Exit code of a chaos-injected crash ("crash@K"): distinguishable from
+# abort() codes and from mpirun's own kill in launcher logs.
+CRASH_EXIT_CODE = 37
+
+_MODES = ("latency", "delay", "corrupt", "truncate", "reset")
+_MAX_LATENCY_S = 0.002
+_MAX_DELAY_S = 0.020
+
+
+@dataclass(frozen=True)
+class ChaosConfig:
+    """Parsed ``seed:rate:modes`` spec (immutable; shareable)."""
+
+    seed: int
+    rate: float
+    modes: FrozenSet[str]
+    crash_at: Optional[int] = None  # total chaos-visible ops before exit
+
+    @property
+    def wire_modes(self) -> FrozenSet[str]:
+        return self.modes & {"corrupt", "truncate", "reset"}
+
+
+def parse_chaos(spec: str) -> ChaosConfig:
+    """Parse the flag grammar; raises :class:`MpiError` on malformed
+    specs (a typo'd chaos flag must fail loudly, not silently run the
+    job fault-free)."""
+    parts = spec.split(":", 2)
+    if len(parts) != 3:
+        raise MpiError(
+            f"mpi_tpu: malformed --mpi-chaos spec {spec!r}; expected "
+            f"seed:rate:modes (e.g. 42:0.05:delay,corrupt)")
+    seed_s, rate_s, modes_s = parts
+    try:
+        seed = int(seed_s)
+    except ValueError:
+        raise MpiError(f"mpi_tpu: --mpi-chaos seed {seed_s!r} is not an "
+                       f"integer") from None
+    try:
+        rate = float(rate_s)
+    except ValueError:
+        raise MpiError(f"mpi_tpu: --mpi-chaos rate {rate_s!r} is not a "
+                       f"float") from None
+    if not 0.0 <= rate <= 1.0:
+        raise MpiError(f"mpi_tpu: --mpi-chaos rate {rate} outside [0, 1]")
+    modes: List[str] = []
+    crash_at: Optional[int] = None
+    for raw in modes_s.split(","):
+        mode = raw.strip()
+        if not mode:
+            continue
+        if mode.startswith("crash@"):
+            try:
+                crash_at = int(mode[len("crash@"):])
+            except ValueError:
+                raise MpiError(
+                    f"mpi_tpu: --mpi-chaos mode {mode!r}: crash@K needs "
+                    f"an integer K") from None
+            if crash_at < 1:
+                raise MpiError(
+                    f"mpi_tpu: --mpi-chaos crash@{crash_at}: K must "
+                    f"be >= 1")
+            continue
+        if mode not in _MODES:
+            raise MpiError(
+                f"mpi_tpu: unknown --mpi-chaos mode {mode!r}; known: "
+                f"{', '.join(_MODES)}, crash@K")
+        modes.append(mode)
+    if not modes and crash_at is None:
+        raise MpiError(
+            f"mpi_tpu: --mpi-chaos spec {spec!r} names no modes")
+    return ChaosConfig(seed=seed, rate=rate, modes=frozenset(modes),
+                       crash_at=crash_at)
+
+
+@dataclass
+class WireFault:
+    """A wire-plane fault plan for one outbound DATA frame, consumed by
+    the TCP driver's ``_send_frame`` (applied after CRC computation)."""
+
+    corrupt_offset: Optional[int] = None  # byte index into payload region
+    corrupt_bit: int = 0                  # which bit to flip (0..7)
+    truncate_at: Optional[int] = None     # send only this many frame bytes
+    reset: bool = False                   # drop the conn without sending
+
+    def any(self) -> bool:
+        return (self.corrupt_offset is not None
+                or self.truncate_at is not None or self.reset)
+
+
+class ChaosEngine:
+    """Per-rank deterministic fault decider.
+
+    One engine serves both planes: :meth:`on_op` is called once per
+    ``send``/``receive`` (sleeps for latency/delay modes, enforces
+    crash@K, and — for remote sends — returns the :class:`WireFault`
+    the TCP driver applies to that frame)."""
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        self._seq: Dict[Tuple[str, int, int], int] = {}
+        self._ops = 0
+
+    # -- determinism core ---------------------------------------------------
+
+    def _draw(self, op: str, peer: int, tag: int, seq: int,
+              salt: str) -> float:
+        """Uniform [0, 1) derived from a stable hash — thread-schedule
+        and PYTHONHASHSEED independent."""
+        key = f"{self.config.seed}:{op}:{peer}:{tag}:{seq}:{salt}"
+        digest = hashlib.blake2b(key.encode("utf-8"),
+                                 digest_size=8).digest()
+        return int.from_bytes(digest, "little") / float(1 << 64)
+
+    def _next(self, op: str, peer: int, tag: int) -> Tuple[int, int]:
+        """(per-channel sequence, total op count) — both under one lock
+        so crash@K counts every chaos-visible op exactly once."""
+        key = (op, peer, tag)
+        with self._lock:
+            seq = self._seq.get(key, 0) + 1
+            self._seq[key] = seq
+            self._ops += 1
+            return seq, self._ops
+
+    # -- op plane -----------------------------------------------------------
+
+    def on_op(self, op: str, peer: int, tag: int,
+              wire: bool = False) -> Optional[WireFault]:
+        """Account one operation: apply crash@K and delay-mode sleeps;
+        return the wire fault plan for this frame (remote sends with a
+        wire mode active and the dice landing under ``rate``), else
+        ``None``."""
+        cfg = self.config
+        seq, total = self._next(op, peer, tag)
+        if cfg.crash_at is not None and total >= cfg.crash_at:
+            import sys as _sys
+
+            print(f"mpi_tpu: chaos crash@{cfg.crash_at} — injected rank "
+                  f"death (op {total}: {op} peer={peer} tag={tag})",
+                  file=_sys.stderr)
+            _sys.stderr.flush()
+            os._exit(CRASH_EXIT_CODE)
+        if "latency" in cfg.modes and \
+                self._draw(op, peer, tag, seq, "lat?") < cfg.rate:
+            time.sleep(self._draw(op, peer, tag, seq, "lat") * _MAX_LATENCY_S)
+        if "delay" in cfg.modes and \
+                self._draw(op, peer, tag, seq, "dly?") < cfg.rate:
+            time.sleep(self._draw(op, peer, tag, seq, "dly") * _MAX_DELAY_S)
+        if not wire or op != "send" or not cfg.wire_modes:
+            return None
+        if self._draw(op, peer, tag, seq, "wire?") >= cfg.rate:
+            return None
+        # Pick one active wire mode deterministically.
+        modes = sorted(cfg.wire_modes)
+        mode = modes[int(self._draw(op, peer, tag, seq, "mode")
+                         * len(modes))]
+        fault = WireFault()
+        if mode == "corrupt":
+            fault.corrupt_offset = int(
+                self._draw(op, peer, tag, seq, "off") * (1 << 30))
+            fault.corrupt_bit = int(
+                self._draw(op, peer, tag, seq, "bit") * 8)
+        elif mode == "truncate":
+            fault.truncate_at = int(
+                self._draw(op, peer, tag, seq, "cut") * (1 << 30))
+        elif mode == "reset":
+            fault.reset = True
+        return fault
+
+
+class ChaosNetwork:
+    """Interface wrapper running any backend under op-plane chaos.
+
+    Wire-plane faults need frame access, so when the inner backend
+    exposes a ``_chaos`` attachment point (the TCP driver) the engine is
+    installed there and the driver does all injection itself — the
+    wrapper then only forwards, avoiding double-counting ops. Every
+    other attribute (collectives, ``iprobe``, ``host_key``, ...)
+    passes through untouched, so the facade's capability probing sees
+    exactly the inner backend's surface.
+
+    ``--mpi-chaos`` / ``MPI_TPU_CHAOS`` reaches the default TCP backend
+    without this wrapper (the driver self-installs from flags); wrap
+    explicitly to put other backends — or a hand-built engine — under
+    chaos."""
+
+    def __init__(self, inner: Interface,
+                 spec: Optional[str] = None,
+                 engine: Optional[ChaosEngine] = None):
+        if engine is None:
+            if spec is None:
+                raise MpiError("mpi_tpu: ChaosNetwork needs a chaos spec "
+                               "or a prebuilt ChaosEngine")
+            engine = ChaosEngine(parse_chaos(spec))
+        self._inner = inner
+        self._engine = engine
+        self._wire_level = hasattr(inner, "_chaos")
+        if self._wire_level:
+            inner._chaos = engine
+
+    # -- Interface ----------------------------------------------------------
+
+    def init(self) -> None:
+        self._inner.init()
+
+    def finalize(self) -> None:
+        self._inner.finalize()
+
+    def rank(self) -> int:
+        return self._inner.rank()
+
+    def size(self) -> int:
+        return self._inner.size()
+
+    def send(self, data: Any, dest: int, tag: int) -> None:
+        if not self._wire_level:
+            self._engine.on_op("send", dest, tag)
+        self._inner.send(data, dest, tag)
+
+    def receive(self, source: int, tag: int,
+                out: Optional[Any] = None) -> Any:
+        if not self._wire_level:
+            self._engine.on_op("receive", source, tag)
+        return self._inner.receive(source, tag, out=out)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"ChaosNetwork({self._inner!r}, config={self._engine.config})"

@@ -208,6 +208,29 @@ class Comm:
         raise MpiError("mpi_tpu: a Comm does not own the network; call "
                        "mpi_tpu.finalize() on the driver instead")
 
+    def Abort(self, errorcode: int = 1) -> None:
+        """MPI_Abort (mpi4py spelling): terminate the job.
+
+        Propagates an ABORT control frame to every peer (drivers that
+        support it — the remote ranks' pending and future operations
+        raise), then exits this process with ``errorcode``. Like
+        MPI_Abort, this makes a best effort to kill the whole job, not
+        just this communicator's group."""
+        from . import api as _api
+
+        # Notify through THIS comm's driver first: a Comm built over an
+        # unregistered impl (in-process harnesses) would otherwise only
+        # notify whatever the facade registry holds. When the impl IS
+        # the registered backend, api.abort() already notifies it —
+        # skip the duplicate (each notify pays timed-lock acquisitions).
+        notify = getattr(self._impl, "notify_abort", None)
+        if notify is not None and _api._backend is not self._impl:
+            try:
+                notify(errorcode)
+            except BaseException:  # noqa: BLE001 - exiting anyway
+                pass
+        _api.abort(errorcode)
+
     def rank(self) -> int:
         """This process's rank within the group."""
         w = self._impl.rank()

@@ -102,6 +102,8 @@ _KEYWORDS = (
     ("port", ERR_PORT),
     ("info", ERR_INFO),
     ("payload mismatch", ERR_TRUNCATE),
+    ("deadline", ERR_PENDING),
+    ("peer", ERR_PENDING),
 )
 
 
@@ -116,11 +118,15 @@ def classify(exc: BaseException) -> int:
         return _NAME_TO_CODE[m.group(1)]
     # Type-based mapping (import deferred: api imports this module).
     from . import api as _api
-    from .backends.tcp import InitError, ReceiveCancelled
+    from .backends.rendezvous import DeadlineError
+    from .backends.tcp import (ChecksumError, InitError, PeerDeadError,
+                               ReceiveCancelled)
 
     if isinstance(exc, _api.TagError):
         return ERR_TAG
-    if isinstance(exc, ReceiveCancelled):
+    if isinstance(exc, ChecksumError):
+        return ERR_TRUNCATE
+    if isinstance(exc, (ReceiveCancelled, DeadlineError, PeerDeadError)):
         return ERR_PENDING
     if isinstance(exc, (InitError, _api.NotInitializedError)):
         return ERR_OTHER

@@ -33,6 +33,7 @@ __all__ = [
     "MpiFlags",
     "parse_duration",
     "format_duration",
+    "parse_bool",
     "parse_flags",
     "get_flags",
     "set_argv_for_testing",
@@ -41,6 +42,9 @@ __all__ = [
     "FLAG_INITTIMEOUT",
     "FLAG_PROTOCOL",
     "FLAG_PASSWORD",
+    "FLAG_OPTIMEOUT",
+    "FLAG_CRC",
+    "FLAG_CHAOS",
     "DEFAULT_PROTOCOL",
     "DEFAULT_INIT_TIMEOUT",
 ]
@@ -52,6 +56,11 @@ FLAG_ALLADDR = "mpi-alladdr"
 FLAG_INITTIMEOUT = "mpi-inittimeout"
 FLAG_PROTOCOL = "mpi-protocol"
 FLAG_PASSWORD = "mpi-password"
+# Robustness extensions beyond the reference's five (docs/FAULT_TOLERANCE.md):
+# per-operation deadline, per-frame CRC trailer, chaos fault injection.
+FLAG_OPTIMEOUT = "mpi-optimeout"
+FLAG_CRC = "mpi-crc"
+FLAG_CHAOS = "mpi-chaos"
 
 ENV_PREFIX = "MPI_TPU_"
 ENV_ADDR = ENV_PREFIX + "ADDR"
@@ -59,6 +68,9 @@ ENV_ALLADDR = ENV_PREFIX + "ALLADDR"
 ENV_INITTIMEOUT = ENV_PREFIX + "INITTIMEOUT"
 ENV_PROTOCOL = ENV_PREFIX + "PROTOCOL"
 ENV_PASSWORD = ENV_PREFIX + "PASSWORD"
+ENV_OPTIMEOUT = ENV_PREFIX + "OPTIMEOUT"
+ENV_CRC = ENV_PREFIX + "CRC"
+ENV_CHAOS = ENV_PREFIX + "CHAOS"
 
 DEFAULT_PROTOCOL = "tcp"  # flags.go:48 default
 # The reference's DurationFlag has no default (zero value); Network.Init then
@@ -103,6 +115,17 @@ def parse_duration(text: str) -> float:
     return total
 
 
+def parse_bool(text: str) -> bool:
+    """Parse a boolean flag value (``--mpi-crc on``). Accepts Go's
+    strconv.ParseBool set plus on/off; anything else raises."""
+    low = text.strip().lower()
+    if low in ("1", "t", "true", "on", "y", "yes"):
+        return True
+    if low in ("0", "f", "false", "off", "n", "no"):
+        return False
+    raise ValueError(f"invalid boolean {text!r}")
+
+
 def format_duration(seconds: float) -> str:
     """Inverse of :func:`parse_duration`, used when re-injecting flags.
 
@@ -116,13 +139,17 @@ def format_duration(seconds: float) -> str:
 
 @dataclass
 class MpiFlags:
-    """Resolved values of the five ``-mpi-*`` flags (flags.go:10-14)."""
+    """Resolved values of the reference's five ``-mpi-*`` flags
+    (flags.go:10-14) plus the three robustness extensions."""
 
     addr: Optional[str] = None
     alladdr: List[str] = field(default_factory=list)
     inittimeout: Optional[float] = None  # seconds
     protocol: Optional[str] = None
     password: Optional[str] = None
+    optimeout: Optional[float] = None  # seconds; None = no op deadline
+    crc: Optional[bool] = None         # per-frame CRC32 trailer wanted
+    chaos: Optional[str] = None        # raw seed:rate:modes spec
 
     def as_argv(self) -> List[str]:
         """Render back to launcher-injectable argv (gompirun.go:77 ABI)."""
@@ -137,10 +164,17 @@ class MpiFlags:
             out += [f"--{FLAG_PROTOCOL}", self.protocol]
         if self.password is not None:
             out += [f"--{FLAG_PASSWORD}", self.password]
+        if self.optimeout is not None:
+            out += [f"--{FLAG_OPTIMEOUT}", format_duration(self.optimeout)]
+        if self.crc is not None:
+            out += [f"--{FLAG_CRC}", "on" if self.crc else "off"]
+        if self.chaos is not None:
+            out += [f"--{FLAG_CHAOS}", self.chaos]
         return out
 
 
-_FLAG_NAMES = {FLAG_ADDR, FLAG_ALLADDR, FLAG_INITTIMEOUT, FLAG_PROTOCOL, FLAG_PASSWORD}
+_FLAG_NAMES = {FLAG_ADDR, FLAG_ALLADDR, FLAG_INITTIMEOUT, FLAG_PROTOCOL,
+               FLAG_PASSWORD, FLAG_OPTIMEOUT, FLAG_CRC, FLAG_CHAOS}
 
 # Overridable argv source for tests (instead of mutating sys.argv).
 _argv_override: Optional[Sequence[str]] = None
@@ -156,7 +190,7 @@ def _scan_argv(argv: Sequence[str],
     """Extract the given flags from argv, ignoring everything else.
 
     Accepts ``-name value``, ``--name value``, ``-name=value``,
-    ``--name=value``. ``names`` defaults to the five ``-mpi-*`` flags;
+    ``--name=value``. ``names`` defaults to the core ``-mpi-*`` flags;
     the runner passes its own set (``mpi-backend``/``mpi-ranks``) so there
     is exactly one argv grammar in the package.
     """
@@ -190,7 +224,7 @@ def scan_argv(names: set, argv: Optional[Sequence[str]] = None) -> Dict[str, str
 
 def parse_flags(argv: Optional[Sequence[str]] = None,
                 environ: Optional[Dict[str, str]] = None) -> MpiFlags:
-    """Resolve the five flags from argv then environment.
+    """Resolve the ``-mpi-*`` flags from argv then environment.
 
     argv wins over env for each individual flag, matching the reference's
     "flags are the source of truth the launcher controls" design.
@@ -222,6 +256,18 @@ def parse_flags(argv: Optional[Sequence[str]] = None,
     password = raw.get(FLAG_PASSWORD, env.get(ENV_PASSWORD))
     if password is not None:
         flags.password = password
+
+    optimeout = raw.get(FLAG_OPTIMEOUT, env.get(ENV_OPTIMEOUT))
+    if optimeout:
+        flags.optimeout = parse_duration(optimeout)
+
+    crc = raw.get(FLAG_CRC, env.get(ENV_CRC))
+    if crc:
+        flags.crc = parse_bool(crc)
+
+    chaos = raw.get(FLAG_CHAOS, env.get(ENV_CHAOS))
+    if chaos:
+        flags.chaos = chaos
 
     return flags
 

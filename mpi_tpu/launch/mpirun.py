@@ -29,16 +29,25 @@ import sys
 import time
 from typing import List, Optional, Sequence
 
-from ..flags import FLAG_ADDR, FLAG_ALLADDR, FLAG_INITTIMEOUT, FLAG_PASSWORD, format_duration
+from ..flags import (FLAG_ADDR, FLAG_ALLADDR, FLAG_CHAOS, FLAG_CRC,
+                     FLAG_INITTIMEOUT, FLAG_OPTIMEOUT, FLAG_PASSWORD,
+                     format_duration)
 
 DEFAULT_PORT_BASE = 6000  # gompirun.go:46
+# Seconds between SIGTERM and SIGKILL when reaping survivors of a failed
+# rank: long enough for atexit/finalize cleanup, short enough that a
+# crashed job ends in seconds, not at the CI timeout.
+DEFAULT_KILL_GRACE = 5.0
 
 
 def build_commands(nprocs: int, prog: str, prog_args: Sequence[str],
                    port_base: int = DEFAULT_PORT_BASE,
                    timeout: Optional[float] = None,
                    password: Optional[str] = None,
-                   host: str = "") -> List[List[str]]:
+                   host: str = "",
+                   optimeout: Optional[float] = None,
+                   crc: Optional[bool] = None,
+                   chaos: Optional[str] = None) -> List[List[str]]:
     """Synthesize the per-rank command lines (the launcher<->program ABI).
 
     Pure function so tests can check the protocol without spawning."""
@@ -56,6 +65,12 @@ def build_commands(nprocs: int, prog: str, prog_args: Sequence[str],
             cmd += [f"--{FLAG_INITTIMEOUT}", format_duration(timeout)]
         if password is not None:
             cmd += [f"--{FLAG_PASSWORD}", password]
+        if optimeout is not None:
+            cmd += [f"--{FLAG_OPTIMEOUT}", format_duration(optimeout)]
+        if crc is not None:
+            cmd += [f"--{FLAG_CRC}", "on" if crc else "off"]
+        if chaos is not None:
+            cmd += [f"--{FLAG_CHAOS}", chaos]
         cmds.append(cmd)
     return cmds
 
@@ -64,12 +79,20 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
            port_base: int = DEFAULT_PORT_BASE,
            timeout: Optional[float] = None,
            password: Optional[str] = None,
-           env: Optional[dict] = None) -> int:
+           env: Optional[dict] = None,
+           kill_grace: float = DEFAULT_KILL_GRACE,
+           optimeout: Optional[float] = None,
+           crc: Optional[bool] = None,
+           chaos: Optional[str] = None) -> int:
     """Spawn all ranks concurrently, wait for all (gompirun.go:57-93).
 
-    Returns the first non-zero child exit code, else 0."""
+    Returns the first non-zero child exit code, else 0. When any rank
+    exits nonzero the survivors get SIGTERM immediately and SIGKILL
+    after ``kill_grace`` seconds — a crashed rank ends the whole job in
+    seconds, never at the CI timeout."""
     cmds = build_commands(nprocs, prog, prog_args, port_base=port_base,
-                          timeout=timeout, password=password)
+                          timeout=timeout, password=password,
+                          optimeout=optimeout, crc=crc, chaos=chaos)
     procs: List[subprocess.Popen] = []
     child_env = dict(os.environ if env is None else env)
     # Children run with the PROGRAM's cwd on their sys.path, not this
@@ -89,8 +112,12 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
     # Poll until every rank exits — but once any rank fails, kill the
     # survivors instead of letting them sit in dial-retry until the init
     # timeout (a CI-friendliness improvement over the reference, which
-    # only logs failures, gompirun.go:90-92).
+    # only logs failures, gompirun.go:90-92). SIGTERM first, then
+    # SIGKILL after the grace period: a survivor stuck in native code
+    # or ignoring SIGTERM cannot wedge the launcher.
     first_bad: Optional[int] = None
+    kill_deadline: Optional[float] = None
+    killed = False
     pending = set(range(nprocs))
     while pending:
         for i in sorted(pending):
@@ -101,9 +128,19 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
             if code and first_bad is None:
                 first_bad = code
                 print(f"mpirun: rank {i} exited with code {code}; "
-                      f"terminating remaining ranks", file=sys.stderr)
+                      f"terminating remaining ranks "
+                      f"(SIGKILL in {kill_grace:g}s)", file=sys.stderr)
                 for j in pending:
                     procs[j].terminate()
+                kill_deadline = time.monotonic() + kill_grace
+        if pending and kill_deadline is not None and not killed \
+                and time.monotonic() >= kill_deadline:
+            print(f"mpirun: ranks {sorted(pending)} survived the "
+                  f"{kill_grace:g}s grace period; killing",
+                  file=sys.stderr)
+            for j in pending:
+                procs[j].kill()
+            killed = True
         if pending:
             time.sleep(0.05)
     return first_bad or 0
@@ -121,6 +158,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "--mpi-inittimeout")
     parser.add_argument("--password", default=None,
                         help="shared secret injected as --mpi-password")
+    parser.add_argument("--optimeout", type=float, default=None,
+                        help="per-operation deadline in seconds injected "
+                             "as --mpi-optimeout")
+    parser.add_argument("--crc", action="store_true", default=None,
+                        help="enable per-frame CRC32 integrity "
+                             "(injected as --mpi-crc on)")
+    parser.add_argument("--chaos", default=None,
+                        help="chaos fault-injection spec seed:rate:modes "
+                             "injected as --mpi-chaos")
+    parser.add_argument("--kill-grace", type=float,
+                        default=DEFAULT_KILL_GRACE,
+                        help="seconds between SIGTERM and SIGKILL when "
+                             "reaping survivors of a failed rank")
     parser.add_argument("nprocs", type=int,
                         help="number of ranks to launch")
     parser.add_argument("prog", help="program to run (.py runs under python)")
@@ -131,7 +181,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         parser.error("N must be >= 1")
     return launch(args.nprocs, args.prog, args.prog_args,
                   port_base=args.port_base, timeout=args.timeout,
-                  password=args.password)
+                  password=args.password, kill_grace=args.kill_grace,
+                  optimeout=args.optimeout, crc=args.crc,
+                  chaos=args.chaos)
 
 
 if __name__ == "__main__":

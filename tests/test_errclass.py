@@ -1,0 +1,140 @@
+"""errclass.py derivation-chain tests.
+
+The error CLASS of a framework exception is derived, not stored
+(errclass.py module doc), with a fixed precedence:
+
+    explicit ``(MPI_ERR_XXX)`` marker in the message
+      > exception type
+        > conservative message-keyword scan
+          > ``ERR_OTHER`` (MpiError) / ``ERR_UNKNOWN`` (foreign)
+
+Each link is pinned here, including the robustness errors added with the
+chaos layer (deadline -> ERR_PENDING, integrity -> ERR_TRUNCATE).
+"""
+
+import pytest
+
+from mpi_tpu import errclass
+from mpi_tpu.api import MpiError, NotInitializedError, TagError
+from mpi_tpu.backends.rendezvous import DeadlineError, ReceiveCancelled
+from mpi_tpu.backends.tcp import (ChecksumError, InitError, PeerDeadError,
+                                  RemoteAbortError)
+
+
+class TestMarkerPrecedence:
+    def test_explicit_marker_wins(self):
+        exc = MpiError("anything at all (MPI_ERR_WIN)")
+        assert errclass.classify(exc) == errclass.ERR_WIN
+
+    def test_marker_beats_type(self):
+        # A TagError whose message carries a different marker: the
+        # marker is the most specific signal and wins over the type.
+        exc = TagError(1, 0)
+        exc.args = ("tag misuse, but really (MPI_ERR_ROOT)",)
+        assert errclass.classify(exc) == errclass.ERR_ROOT
+
+    def test_marker_beats_keywords(self):
+        exc = MpiError("bad rank and tag everywhere (MPI_ERR_SPAWN)")
+        assert errclass.classify(exc) == errclass.ERR_SPAWN
+
+    def test_unknown_marker_falls_through(self):
+        # A marker that names no real class must not crash, and the
+        # scan continues down the chain.
+        exc = MpiError("strange (MPI_ERR_NOT_A_CLASS) rank problem")
+        assert errclass.classify(exc) == errclass.ERR_RANK
+
+
+class TestTypeMapping:
+    def test_tag_error(self):
+        assert errclass.classify(TagError(5, 1)) == errclass.ERR_TAG
+
+    def test_receive_cancelled(self):
+        exc = ReceiveCancelled("cancelled")
+        assert errclass.classify(exc) == errclass.ERR_PENDING
+
+    def test_deadline_error_is_err_pending(self):
+        exc = DeadlineError("receive(source=1, tag=9)", 2.0)
+        assert errclass.classify(exc) == errclass.ERR_PENDING
+        # Both the marker and the type agree; strip the marker to prove
+        # the type alone suffices.
+        exc.args = ("no marker here",)
+        assert errclass.classify(exc) == errclass.ERR_PENDING
+
+    def test_checksum_error_is_err_truncate(self):
+        exc = ChecksumError(src=3, tag=17)
+        assert errclass.classify(exc) == errclass.ERR_TRUNCATE
+        exc.args = ("no marker here",)
+        assert errclass.classify(exc) == errclass.ERR_TRUNCATE
+
+    def test_peer_dead_error_is_err_pending(self):
+        exc = PeerDeadError(2, ConnectionError("gone"))
+        assert errclass.classify(exc) == errclass.ERR_PENDING
+        exc.args = ("no marker here",)
+        assert errclass.classify(exc) == errclass.ERR_PENDING
+
+    def test_init_and_not_initialized_are_err_other(self):
+        assert errclass.classify(InitError("boom")) == errclass.ERR_OTHER
+        assert errclass.classify(
+            NotInitializedError("call init() first")) == errclass.ERR_OTHER
+
+    def test_remote_abort_is_err_other(self):
+        assert errclass.classify(
+            RemoteAbortError(1, 7)) == errclass.ERR_OTHER
+
+
+class TestKeywordScan:
+    @pytest.mark.parametrize("msg,code", [
+        ("mpi_tpu: tag 9 already live", errclass.ERR_TAG),
+        ("mpi_tpu: peer rank 9 out of range", errclass.ERR_RANK),
+        ("mpi_tpu: invalid root 4", errclass.ERR_ROOT),
+        ("mpi_tpu: window epoch mismatch", errclass.ERR_WIN),
+        ("mpi_tpu: truncated payload", errclass.ERR_TRUNCATE),
+        ("mpi_tpu: unknown reduction op", errclass.ERR_OP),
+        ("mpi_tpu: operation deadline elapsed", errclass.ERR_PENDING),
+        ("connection closed by peer", errclass.ERR_PENDING),
+    ])
+    def test_keywords(self, msg, code):
+        assert errclass.classify(MpiError(msg)) == code
+
+    def test_keyword_order_tag_before_rank(self):
+        # First match in the table wins; "tag" precedes "rank".
+        exc = MpiError("tag 3 for rank 2 busted")
+        assert errclass.classify(exc) == errclass.ERR_TAG
+
+
+class TestFallbacks:
+    def test_mpi_error_with_no_signal_is_err_other(self):
+        assert errclass.classify(
+            MpiError("something opaque went wrong")) == errclass.ERR_OTHER
+
+    def test_foreign_exception_is_err_unknown(self):
+        assert errclass.classify(
+            ValueError("not ours, no keywords")) == errclass.ERR_UNKNOWN
+
+    def test_never_raises(self):
+        class Evil(Exception):
+            def __str__(self):
+                return ""
+
+        assert errclass.classify(Evil()) in (errclass.ERR_UNKNOWN,
+                                             errclass.ERR_OTHER)
+
+
+class TestErrorStrings:
+    def test_error_string(self):
+        assert errclass.error_string(errclass.SUCCESS) == \
+            "MPI_SUCCESS: no error"
+        assert errclass.error_string(errclass.ERR_TRUNCATE) == \
+            "MPI_ERR_TRUNCATE"
+        assert "unknown" in errclass.error_string(424242)
+
+    def test_error_class_identity(self):
+        assert errclass.error_class(errclass.ERR_PENDING) == \
+            errclass.ERR_PENDING
+        assert errclass.error_class(424242) == errclass.ERR_UNKNOWN
+
+    def test_exception_protocol(self):
+        exc = ChecksumError(src=1, tag=2)
+        assert exc.Get_error_class() == errclass.ERR_TRUNCATE
+        assert exc.Get_error_code() == errclass.ERR_TRUNCATE
+        assert exc.Get_error_string() == "MPI_ERR_TRUNCATE"

@@ -85,3 +85,44 @@ class TestParseFlags:
                         inittimeout=10.0, protocol="tcp", password="x")
         again = F.parse_flags(fl.as_argv(), environ={})
         assert again == fl
+
+
+class TestRobustnessFlags:
+    """--mpi-optimeout / --mpi-crc / --mpi-chaos (docs/FAULT_TOLERANCE.md)."""
+
+    def test_optimeout_duration_grammar(self):
+        fl = F.parse_flags(["--mpi-optimeout", "1m30s"], environ={})
+        assert fl.optimeout == 90.0
+        fl = F.parse_flags([], environ={F.ENV_OPTIMEOUT: "250ms"})
+        assert fl.optimeout == pytest.approx(0.25)
+
+    def test_crc_bool_grammar(self):
+        for text, want in [("on", True), ("1", True), ("true", True),
+                           ("off", False), ("0", False), ("false", False)]:
+            fl = F.parse_flags(["--mpi-crc", text], environ={})
+            assert fl.crc is want, text
+        with pytest.raises(ValueError):
+            F.parse_flags(["--mpi-crc", "maybe"], environ={})
+
+    def test_chaos_spec_passes_through_raw(self):
+        # The flag layer transports the spec; mpi_tpu.chaos parses it
+        # (so a chaos-less run never imports the chaos module).
+        fl = F.parse_flags(["--mpi-chaos", "42:0.1:delay,corrupt"],
+                           environ={})
+        assert fl.chaos == "42:0.1:delay,corrupt"
+        fl = F.parse_flags([], environ={F.ENV_CHAOS: "7:1:latency"})
+        assert fl.chaos == "7:1:latency"
+
+    def test_unset_by_default(self):
+        fl = F.parse_flags([], environ={})
+        assert fl.optimeout is None
+        assert fl.crc is None
+        assert fl.chaos is None
+
+    def test_as_argv_roundtrip_with_extensions(self):
+        fl = F.MpiFlags(addr=":6000", optimeout=2.0, crc=True,
+                        chaos="1:0.5:delay")
+        again = F.parse_flags(fl.as_argv(), environ={})
+        assert again == fl
+        fl_off = F.MpiFlags(crc=False)
+        assert F.parse_flags(fl_off.as_argv(), environ={}).crc is False

@@ -527,3 +527,86 @@ class TestProtocols:
         assert _split_hostport("[fe80::2]:08080") == ("fe80::2", 8080)
         assert _split_hostport("127.0.0.1:5000") == ("127.0.0.1", 5000)
         assert _split_hostport(":5000") == ("", 5000)
+
+
+class TestFinalizeIdempotent:
+    def test_finalize_twice_is_noop(self):
+        net = TcpNetwork(timeout=1.0)
+        net.init()
+        net.finalize()
+        net.finalize()  # second call must not raise or re-close
+
+    def test_finalize_without_init(self):
+        # Error-path cleanup (tests, chaos harness) calls finalize()
+        # unconditionally — including on a never-inited backend.
+        TcpNetwork().finalize()
+
+    def test_finalize_after_failed_init(self):
+        from conftest import _free_ports
+
+        port = _free_ports(1)[0]
+        addrs = [f"127.0.0.1:{port:05d}", f"127.0.0.1:{port + 1:05d}"]
+        net = TcpNetwork(addr=addrs[0], addrs=addrs, timeout=0.3)
+        with pytest.raises(InitError):
+            net.init()  # peer never shows up
+        net.finalize()  # bootstrap already cleaned up; this is a no-op
+        net.finalize()
+
+    def test_cluster_finalize_all_twice(self, cluster4):
+        for net in cluster4:
+            net.finalize()
+        for net in cluster4:
+            net.finalize()
+
+
+class TestRecvExactHardening:
+    """A socket.timeout mid-frame desynchronizes the stream: it must be
+    a fatal ConnectionError for that peer, never a retryable timeout
+    (a later retry would read from the middle of the frame)."""
+
+    def _pair(self):
+        import socket as socketmod
+
+        a, b = socketmod.socketpair()
+        return a, b
+
+    def test_timeout_on_frame_boundary_stays_timeout(self):
+        import socket as socketmod
+
+        from mpi_tpu.backends.tcp import _recv_exact
+
+        a, b = self._pair()
+        try:
+            a.settimeout(0.2)
+            with pytest.raises(socketmod.timeout):
+                _recv_exact(a, 4)  # nothing sent: clean boundary
+        finally:
+            a.close()
+            b.close()
+
+    def test_timeout_mid_read_is_fatal(self):
+        from mpi_tpu.backends.tcp import _recv_exact
+
+        a, b = self._pair()
+        try:
+            a.settimeout(0.3)
+            b.sendall(b"\x01\x02")  # 2 of 8 bytes, then silence
+            with pytest.raises(ConnectionError, match="desynchronized"):
+                _recv_exact(a, 8)
+        finally:
+            a.close()
+            b.close()
+
+    def test_timeout_on_later_segment_is_fatal(self):
+        # The payload read of a frame whose header already arrived is
+        # mid-frame even when 0 of its own bytes arrived yet.
+        from mpi_tpu.backends.tcp import _recv_exact
+
+        a, b = self._pair()
+        try:
+            a.settimeout(0.3)
+            with pytest.raises(ConnectionError, match="desynchronized"):
+                _recv_exact(a, 4, midframe=True)
+        finally:
+            a.close()
+            b.close()

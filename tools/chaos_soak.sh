@@ -1,0 +1,43 @@
+#!/bin/bash
+# Repeated seeded chaos soak (tools/ sibling of tunnel_watch.sh).
+#
+# Loops the slow chaos suites — the multi-seed delay/reorder bit-exact
+# soak and the low-rate corruption soak — across a sweep of seeds fed
+# in via MPI_TPU_CHAOS-style specs, logging one line per iteration to
+# CHAOS_SOAK_LOG.md. Every fault decision is a pure function of the
+# seed (mpi_tpu/chaos.py), so any failure line is an exact repro
+# recipe: rerun with the printed seed.
+#
+# Usage:
+#   tools/chaos_soak.sh            # default 10 iterations
+#   tools/chaos_soak.sh 100        # longer soak
+#   SEED_BASE=500 tools/chaos_soak.sh
+cd "$(dirname "$0")/.." || exit 1
+
+ITERS="${1:-10}"
+SEED_BASE="${SEED_BASE:-0}"
+LOG=CHAOS_SOAK_LOG.md
+
+echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): soak start iters=$ITERS seed_base=$SEED_BASE" >> "$LOG"
+
+fails=0
+for i in $(seq 1 "$ITERS"); do
+  seed=$((SEED_BASE + i))
+  # Yield to a foreign bench run, as tunnel_watch.sh does: chaos delay
+  # timing plus a contended core makes spurious slowness, not signal.
+  while pgrep -f "python[^ ]* ([^ ]*/)?bench\.py" > /dev/null 2>&1; do
+    sleep 60
+  done
+  if JAX_PLATFORMS=cpu MPI_TPU_CHAOS_SOAK_SEED="$seed" timeout 900 \
+      python -m pytest tests/test_chaos.py -q -m slow \
+      -p no:cacheprovider > /tmp/chaos_soak_run.log 2>&1; then
+    echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): seed $seed OK" >> "$LOG"
+  else
+    fails=$((fails + 1))
+    tail -5 /tmp/chaos_soak_run.log | sed 's/^/    /' >> "$LOG"
+    echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): seed $seed FAIL (log above)" >> "$LOG"
+  fi
+done
+
+echo "- $(date -u '+%Y-%m-%d %H:%M UTC'): soak done, $fails/$ITERS failed" >> "$LOG"
+exit "$((fails > 0))"
