@@ -1080,7 +1080,8 @@ def _bounce_tcp_child() -> int:
 
 def bounce_tcp(proto: str = "tcp", port_base: int = 6200,
                timeout: float = 30.0,
-               size: Optional[int] = None) -> float:
+               size: Optional[int] = None,
+               metrics_out: Optional[str] = None) -> float:
     """Mean round-trip µs for the socket driver, 2 real processes —
     the reference's own transport method (bounce.go:85-112),
     re-measured every run so the headline's comparison can never go
@@ -1104,6 +1105,15 @@ def bounce_tcp(proto: str = "tcp", port_base: int = 6200,
         # Children never touch the accelerator — keep them off the chip
         # the parent is benchmarking.
         env["JAX_PLATFORMS"] = "cpu"
+        if metrics_out is not None:
+            # Observe-layer artifact (docs/OBSERVABILITY.md): each rank
+            # writes its --mpi-metrics-out JSON at finalize; the caller
+            # digests it into the BENCH record. Tracing rides along so
+            # the artifact carries the per-peer wire byte counters —
+            # this launch is SEPARATE from the timed bounce legs, so
+            # the span overhead never touches the committed latencies.
+            env["MPI_TPU_METRICS_OUT"] = metrics_out
+            env["MPI_TPU_TRACE"] = "1"
         args = ["--_bounce-child"]
         kwargs = {}
         if proto != "tcp":
@@ -1117,6 +1127,34 @@ def bounce_tcp(proto: str = "tcp", port_base: int = 6200,
         if rc != 0:
             raise RuntimeError(f"{proto} bounce children failed rc={rc}")
         return float(f.read() or "nan")
+
+
+def bounce_metrics_digest(port_base: int = 6420) -> dict:
+    """One extra small-message TCP bounce with ``--mpi-metrics-out``
+    live; digests rank 0's artifact (facade op p50/p99, per-peer wire
+    rate) into BENCH keys — the observe layer's machine-readable
+    output folded into the round, per ISSUE 8."""
+    import tempfile
+
+    from mpi_tpu.observe import metrics as obs_metrics
+
+    with tempfile.TemporaryDirectory() as td:
+        pattern = os.path.join(td, "metrics-{rank}.json")
+        bounce_tcp(port_base=port_base, metrics_out=pattern)
+        with open(os.path.join(td, "metrics-0.json")) as f:
+            doc = json.load(f)
+        obs_metrics.validate(doc)
+        keys = {}
+        for op in ("send", "receive"):
+            st = doc["ops"].get(op)
+            if st:
+                keys[f"bounce_metrics_{op}_p50_us"] = round(
+                    st["p50_us"], 1)
+                keys[f"bounce_metrics_{op}_p99_us"] = round(
+                    st["p99_us"], 1)
+        tx = sum(p.get("tx_bytes", 0) for p in doc["peers"].values())
+        keys["bounce_metrics_tx_bytes_rank0"] = int(tx)
+        return keys
 
 
 # --------------------------------------------------------------------------
@@ -1819,6 +1857,14 @@ def main() -> int:
             except Exception as exc:  # noqa: BLE001 - leg optional
                 keys[f"bounce64m_{proto}_error"] = str(exc)[:200]
             _PARTIALS.update(keys)
+        # Observe fold: the --mpi-metrics-out artifact of one extra
+        # small-message launch, digested into the round (facade op
+        # p50/p99 as the flight recorder measures them).
+        try:
+            keys.update(bounce_metrics_digest(port_base=6420))
+        except Exception as exc:  # noqa: BLE001 - leg optional
+            keys["bounce_metrics_error"] = str(exc)[:200]
+        _PARTIALS.update(keys)
         return keys
 
     # Headline first: if anything later blows the watchdog, the

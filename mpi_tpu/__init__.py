@@ -27,7 +27,11 @@ point-to-point library over TCP, /root/reference) designed TPU-first:
   * a native runtime core (:mod:`mpi_tpu.native`): C++ socket frame
     engine, shared-memory ring transport (``-mpi-protocol shm``), and
     batch-gather data-loader kernel, all ctypes-loaded with pure-Python
-    fallbacks.
+    fallbacks;
+  * job-wide observability (:mod:`mpi_tpu.observe`): distributed trace
+    collection into one clock-aligned chrome trace, a flight recorder
+    whose postmortems narrate typed failures, and live metrics with
+    straggler detection (docs/OBSERVABILITY.md).
 """
 
 from .comm import CartComm, Comm, cart_create, comm_self, comm_world

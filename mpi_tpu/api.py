@@ -243,6 +243,15 @@ def init() -> None:
     impl.init()
     with _lock:
         _init_count += 1
+    # Observability bring-up (rank binding for the flight recorder,
+    # SIGUSR1 top handler, implicit span enable when a trace sink is
+    # configured) — defensive: it must never take init down.
+    try:
+        from . import observe
+
+        observe.on_init(impl)
+    except Exception:  # noqa: BLE001 - observability is best-effort
+        pass
 
 
 def finalize() -> None:
@@ -262,6 +271,16 @@ def finalize() -> None:
         for key in [k for k in chains if k[0] == id(impl)]:
             _drain_chain(key)
             chains.pop(key, None)
+    # Job-wide observability flush BEFORE transport teardown: trace
+    # collection is a gather over the live transport (collective when
+    # --mpi-trace-out is set on every rank), metrics/summary are local.
+    if _init_count > 0:
+        try:
+            from . import observe
+
+            observe.on_finalize(impl)
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            pass
     with _lock:
         _init_count = max(0, _init_count - 1)
     impl.finalize()
@@ -322,6 +341,15 @@ def get_errhandler() -> Any:
 def _dispatch_error(exc: MpiError) -> None:
     """Route ``exc`` through the installed handler; never returns
     normally (raises or exits)."""
+    # Flight recorder: the FIRST fatal typed failure (remote abort,
+    # deadline, peer death, wire corruption) dumps this rank's
+    # postmortem before the error propagates (docs/OBSERVABILITY.md).
+    try:
+        from . import observe
+
+        observe.fatal_error_hook(exc)
+    except Exception:  # noqa: BLE001 - never mask the real error
+        pass
     handler = _errhandler
     if handler == "fatal":
         import sys as _sys
@@ -387,15 +415,29 @@ def send(data: Any, dest: int, tag: int) -> None:
     impl = _require_init()
     _check_peer(dest, impl)
     _check_tag(tag)
+    from .observe import flight
     from .utils import trace
 
-    if not trace.enabled():
+    tracing = trace.enabled()
+    if not tracing and not flight.enabled:
         return impl.send(data, dest, tag)
     nbytes = _payload_bytes(data)
-    trace.count("comm.send.calls")
-    trace.count("comm.send.bytes", nbytes)
-    with trace.span("mpi.send", dest=dest, tag=tag, bytes=nbytes):
-        impl.send(data, dest, tag)
+    tok = flight.begin("send", dest, tag, nbytes) if flight.enabled \
+        else None
+    try:
+        if tracing:
+            trace.count("comm.send.calls")
+            trace.count("comm.send.bytes", nbytes)
+            with trace.span("mpi.send", dest=dest, tag=tag, bytes=nbytes):
+                impl.send(data, dest, tag)
+        else:
+            impl.send(data, dest, tag)
+    except BaseException as exc:
+        if tok is not None:
+            flight.end(tok, f"error:{type(exc).__name__}")
+        raise
+    if tok is not None:
+        flight.end(tok)
 
 
 @_guarded
@@ -408,14 +450,27 @@ def receive(source: int, tag: int, out: Optional[Any] = None) -> Any:
     impl = _require_init()
     _check_peer(source, impl)
     _check_tag(tag)
+    from .observe import flight
     from .utils import trace
 
-    if not trace.enabled():
+    tracing = trace.enabled()
+    if not tracing and not flight.enabled:
         return impl.receive(source, tag, out=out)
-    with trace.span("mpi.receive", source=source, tag=tag):
-        result = impl.receive(source, tag, out=out)
-    trace.count("comm.receive.calls")
-    trace.count("comm.receive.bytes", _payload_bytes(result))
+    tok = flight.begin("receive", source, tag) if flight.enabled else None
+    try:
+        if tracing:
+            with trace.span("mpi.receive", source=source, tag=tag):
+                result = impl.receive(source, tag, out=out)
+            trace.count("comm.receive.calls")
+            trace.count("comm.receive.bytes", _payload_bytes(result))
+        else:
+            result = impl.receive(source, tag, out=out)
+    except BaseException as exc:
+        if tok is not None:
+            flight.end(tok, f"error:{type(exc).__name__}")
+        raise
+    if tok is not None:
+        flight.end(tok)
     return result
 
 
@@ -629,6 +684,12 @@ def abort(code: int = 1) -> None:
 
     print(f"mpi_tpu: abort({code})", file=_sys.stderr)
     try:
+        from .observe import flight as _flight
+
+        _flight.dump(f"abort({code})")
+    except BaseException:  # noqa: BLE001 - exiting anyway
+        pass
+    try:
         impl = registered()
         # Failure propagation (docs/FAULT_TOLERANCE.md): drivers with an
         # ABORT control frame tell every peer first, so remote ranks
@@ -655,19 +716,35 @@ def sendrecv(data: Any, dest: int, source: int, tag: int,
     _check_peer(dest, impl)
     _check_peer(source, impl)
     _check_tag(tag)
+    from .observe import flight
     from .utils import trace
 
-    if not trace.enabled():
+    tracing = trace.enabled()
+    if not tracing and not flight.enabled:
         return exchange(impl, data, dest, source, tag, out=out)
-    # Count the exchange's two legs at this level — the internal engine
-    # (`exchange`) is also used by collectives_generic, whose traffic is
-    # accounted under its own collective name instead.
-    trace.count("comm.send.calls")
-    trace.count("comm.send.bytes", _payload_bytes(data))
-    trace.count("comm.receive.calls")
-    with trace.span("mpi.sendrecv", dest=dest, source=source, tag=tag):
-        result = exchange(impl, data, dest, source, tag, out=out)
-    trace.count("comm.receive.bytes", _payload_bytes(result))
+    tok = flight.begin("sendrecv", dest, tag, _payload_bytes(data)) \
+        if flight.enabled else None
+    try:
+        if tracing:
+            # Count the exchange's two legs at this level — the internal
+            # engine (`exchange`) is also used by collectives_generic,
+            # whose traffic is accounted under its own collective name
+            # instead.
+            trace.count("comm.send.calls")
+            trace.count("comm.send.bytes", _payload_bytes(data))
+            trace.count("comm.receive.calls")
+            with trace.span("mpi.sendrecv", dest=dest, source=source,
+                            tag=tag):
+                result = exchange(impl, data, dest, source, tag, out=out)
+            trace.count("comm.receive.bytes", _payload_bytes(result))
+        else:
+            result = exchange(impl, data, dest, source, tag, out=out)
+    except BaseException as exc:
+        if tok is not None:
+            flight.end(tok, f"error:{type(exc).__name__}")
+        raise
+    if tok is not None:
+        flight.end(tok)
     return result
 
 
@@ -708,15 +785,38 @@ def _collective(name: str, *args: Any, **kwargs: Any) -> Any:
 
         generic = getattr(gen, name)
         call = lambda: generic(impl, *args, **kwargs)  # noqa: E731
+    from .observe import flight
     from .utils import trace
 
-    if not trace.enabled():
+    tracing = trace.enabled()
+    if not tracing and not flight.enabled:
         return call()
-    trace.count(f"comm.{name}.calls")
-    if args:
-        trace.count(f"comm.{name}.bytes", _payload_bytes(args[0]))
-    with trace.span(f"mpi.{name}"):
-        return call()
+    # Straggler substrate: every rank stamps its local arrival at this
+    # collective; the in-process drivers report exact skew, and the
+    # finalize-time merge computes cross-process skew from the
+    # clock-aligned stamps (mpi_tpu.observe.collect).
+    from .observe import metrics as _metrics
+
+    _metrics.note_collective_entry(name)
+    tok = flight.begin(name, -1, -1,
+                       _payload_bytes(args[0]) if args else 0) \
+        if flight.enabled else None
+    try:
+        if tracing:
+            trace.count(f"comm.{name}.calls")
+            if args:
+                trace.count(f"comm.{name}.bytes", _payload_bytes(args[0]))
+            with trace.span(f"mpi.{name}"):
+                result = call()
+        else:
+            result = call()
+    except BaseException as exc:
+        if tok is not None:
+            flight.end(tok, f"error:{type(exc).__name__}")
+        raise
+    if tok is not None:
+        flight.end(tok)
+    return result
 
 
 def allreduce(data: Any, op: "OpLike" = "sum") -> Any:

@@ -114,6 +114,12 @@ class HybridNetwork:
     # mpi_tpu.comm checks this to route neighborhood collectives through
     # the hierarchical group allgather instead of pairwise sendrecv.
     SUPPORTS_COMM_CROSS_HOST_P2P = False
+    # Local ranks are threads sharing one tracer buffer (like the xla
+    # driver), so trace collection writes each host process's buffer
+    # once via its global-rank-0 thread rather than gathering
+    # duplicate per-thread copies. (Cross-host merge of per-host
+    # buffers is an observe-layer follow-on; ROADMAP.)
+    SHARED_PROCESS_TRACER = True
 
     def __init__(self, local_ranks: Optional[int] = None,
                  devices: Optional[Sequence[Any]] = None,
@@ -275,6 +281,17 @@ class HybridNetwork:
         h = self._host_of(dest)
         if h == self._tcp.rank():
             self._inner.send(data, dest - self._my_offset, tag)
+        elif trace.enabled():
+            # Cross-host (DCN-tier) traffic is the scarce resource the
+            # hierarchy exists to conserve — attribute it separately
+            # from intra-host hops.
+            from ..api import _payload_bytes
+
+            nbytes = _payload_bytes(data)
+            trace.count(f"wire.hybrid.tx.bytes.peer{dest}", nbytes)
+            with trace.span("hybrid.xhost_send", dest=dest, tag=tag,
+                            bytes=nbytes):
+                self._tcp.send(data, h, _compose_tag(me, dest, tag))
         else:
             self._tcp.send(data, h, _compose_tag(me, dest, tag))
 
@@ -283,6 +300,15 @@ class HybridNetwork:
         h = self._host_of(source)
         if h == self._tcp.rank():
             return self._inner.receive(source - self._my_offset, tag, out=out)
+        if trace.enabled():
+            from ..api import _payload_bytes
+
+            with trace.span("hybrid.xhost_recv", source=source, tag=tag):
+                result = self._tcp.receive(h, _compose_tag(source, me, tag),
+                                           out=out)
+            trace.count(f"wire.hybrid.rx.bytes.peer{source}",
+                        _payload_bytes(result))
+            return result
         return self._tcp.receive(h, _compose_tag(source, me, tag), out=out)
 
     def cancel_receive(self, source: int, tag: int) -> bool:

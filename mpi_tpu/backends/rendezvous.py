@@ -88,11 +88,24 @@ class TagManager:
             return self._slots.setdefault(tag, queue.Queue()), gen
 
     def cancel(self, tag: int, exc: BaseException) -> bool:
-        """Best-effort cancel of the live claim on ``tag``."""
+        """Best-effort cancel of the live claim on ``tag``.
+
+        MPI's contract: a successful cancel means NO part of the
+        message was received — so a claim whose sender's data frame
+        has already been routed into the slot is NOT cancellable
+        (ADVICE.md round 5): return False and let ``wait()`` deliver
+        the payload. (The token-vs-payload race that remains —
+        payload routed after this check — is resolved by the waiter:
+        a delivered payload wins over a stale token, and
+        ``api.Request.wait`` clears ``cancelled`` when data arrives.)"""
         with self._lock:
             if tag not in self._claimed:
                 return False
             q = self._slots.setdefault(tag, queue.Queue())
+            with q.mutex:
+                if any(not isinstance(item, (Cancel, BaseException))
+                       for item in q.queue):
+                    return False  # message (partly) received already
             gen = self._gen.get(tag, 0)
         q.put(Cancel(gen, exc))
         return True

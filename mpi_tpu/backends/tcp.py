@@ -74,6 +74,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import flags as flagmod
 from ..api import MpiError
+from ..utils import trace
 from ..utils.serialize import decode as codec_decode
 from ..utils.serialize import encode as codec_encode
 from ..utils.serialize import encode_parts as codec_encode_parts
@@ -410,7 +411,15 @@ def _recv_frame(sock, crc: bool = False,
                else bytearray())
     if crc and kind == KIND_DATA:
         trailer = _recv_exact(sock, _CRC_TRAILER.size, midframe=True)
-        if _CRC_TRAILER.unpack(trailer)[0] != \
+        if trace.enabled():
+            t0 = time.perf_counter_ns()
+            ok = _CRC_TRAILER.unpack(trailer)[0] == \
+                _crc32_frame(bytes(header), payload)
+            trace.count("wire.crc.frames")
+            trace.count("wire.crc.ns", time.perf_counter_ns() - t0)
+            if not ok:
+                raise ChecksumError(src, tag)
+        elif _CRC_TRAILER.unpack(trailer)[0] != \
                 _crc32_frame(bytes(header), payload):
             raise ChecksumError(src, tag)
     return kind, tag, payload
@@ -588,13 +597,34 @@ class TcpNetwork:
                              op=f"send(dest={dest}, tag={tag}) self "
                                 f"rendezvous")
             return
-        prefix, view = codec_encode_parts(data)
+        # Per-stage wire spans + per-peer byte counters (observe layer):
+        # frame assembly / socket write / ack wait are separately
+        # attributable — the decomposition the transport-rewrite work
+        # targets (docs/PERF_NOTES.md). One bool check when tracing off.
+        tracing = trace.enabled()
+        if tracing:
+            with trace.span("wire.encode", dest=dest, tag=tag):
+                prefix, view = codec_encode_parts(data)
+            nbytes = len(prefix) + (0 if view is None
+                                    else memoryview(view).nbytes)
+            trace.count("wire.tx.frames")
+            trace.count(f"wire.{self.proto}.tx.bytes.peer{dest}", nbytes)
+        else:
+            prefix, view = codec_encode_parts(data)
         peer = self._peers[dest]
         ackq, gen = peer.sendtags.claim(tag)
         try:
             try:
-                _send_frame(peer.dial_sock, peer.dial_lock, KIND_DATA, tag,
-                            prefix, view, crc=peer.dial_crc, fault=fault)
+                if tracing:
+                    with trace.span("wire.write", dest=dest, tag=tag,
+                                    bytes=nbytes, crc=peer.dial_crc):
+                        _send_frame(peer.dial_sock, peer.dial_lock,
+                                    KIND_DATA, tag, prefix, view,
+                                    crc=peer.dial_crc, fault=fault)
+                else:
+                    _send_frame(peer.dial_sock, peer.dial_lock, KIND_DATA,
+                                tag, prefix, view, crc=peer.dial_crc,
+                                fault=fault)
             except OSError as exc:
                 # The conn died under us (peer crashed; chaos reset by a
                 # sibling thread) before the reader poisoned the tags —
@@ -602,8 +632,15 @@ class TcpNetwork:
                 raise (peer.dead if peer.dead is not None
                        else PeerDeadError(peer.rank, exc)) from exc
             # Blocks until the receiver's ack (network.go:569).
-            peer.sendtags.wait(ackq, gen, timeout=self.optimeout,
-                               op=f"send(dest={dest}, tag={tag}) ack wait")
+            if tracing:
+                with trace.span("wire.ack_wait", dest=dest, tag=tag):
+                    peer.sendtags.wait(
+                        ackq, gen, timeout=self.optimeout,
+                        op=f"send(dest={dest}, tag={tag}) ack wait")
+            else:
+                peer.sendtags.wait(ackq, gen, timeout=self.optimeout,
+                                   op=f"send(dest={dest}, tag={tag}) "
+                                      f"ack wait")
         finally:
             peer.sendtags.release(tag)
 
@@ -623,10 +660,18 @@ class TcpNetwork:
             return codec_decode(payload, out=out)
         peer = self._peers[source]
         slot, gen = peer.receivetags.claim(tag)
+        tracing = trace.enabled()
         try:
-            payload = peer.receivetags.wait(
-                slot, gen, timeout=self.optimeout,
-                op=f"receive(source={source}, tag={tag})")
+            if tracing:
+                with trace.span("wire.payload_wait", source=source,
+                                tag=tag):
+                    payload = peer.receivetags.wait(
+                        slot, gen, timeout=self.optimeout,
+                        op=f"receive(source={source}, tag={tag})")
+            else:
+                payload = peer.receivetags.wait(
+                    slot, gen, timeout=self.optimeout,
+                    op=f"receive(source={source}, tag={tag})")
             # Ack on the listen conn — this is what unblocks the sender's
             # rendezvous (network.go:617-624); written only now, when the
             # receive has genuinely accepted the data. A failed ack write
@@ -640,6 +685,12 @@ class TcpNetwork:
                 pass
         finally:
             peer.receivetags.release(tag)
+        if tracing:
+            trace.count(f"wire.{self.proto}.rx.bytes.peer{source}",
+                        len(payload))
+            with trace.span("wire.decode", source=source, tag=tag,
+                            bytes=len(payload)):
+                return codec_decode(payload, out=out)
         return codec_decode(payload, out=out)
 
     def notify_abort(self, code: int) -> None:

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import copy
 import threading
+import time
 from collections import OrderedDict
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -120,16 +121,35 @@ class _CollectiveSession:
         self._slots: List[Any] = [None] * n
         self._results: List[Any] = [None] * n
         self._error: Optional[BaseException] = None
+        # Per-collective arrival stamps (perf ns): all rank threads
+        # share one clock, so the barrier winner reads EXACT skew —
+        # the straggler-detection source for the in-process drivers.
+        self._arrivals: List[int] = [0] * n
+
+    def _note_skew(self, name: str) -> None:
+        from ..observe import flight, metrics
+        from ..utils import trace
+
+        if not (flight.enabled or trace.enabled()):
+            return
+        arr = self._arrivals
+        lo, hi = min(arr), max(arr)
+        if lo <= 0:
+            return
+        metrics.note_session_skew(name, (hi - lo) / 1e3, arr.index(hi))
 
     def run(self, rank: int, value: Any,
-            leader: Callable[[List[Any]], List[Any]]) -> Any:
+            leader: Callable[[List[Any]], List[Any]],
+            name: str = "collective") -> Any:
         self._slots[rank] = value
+        self._arrivals[rank] = time.perf_counter_ns()
         try:
             arrival = self._barrier.wait()
         except threading.BrokenBarrierError as exc:
             raise MpiError(
                 "mpi_tpu: collective aborted (another rank failed)") from exc
         if arrival == 0:
+            self._note_skew(name)
             try:
                 self._results = leader(list(self._slots))
                 self._error = None
@@ -391,10 +411,11 @@ class _MeshCollectives:
         from ..collectives_generic import check_op
 
         check_op(op)
-        return self._coll.run(me, data, leader)
+        return self._coll.run(me, data, leader, name="allreduce")
 
     def barrier(self) -> None:
-        self._coll.run(self._myrank(), None, lambda slots: [None] * self._n)
+        self._coll.run(self._myrank(), None,
+                       lambda slots: [None] * self._n, name="barrier")
 
     def bcast(self, data: Any, root: int = 0) -> Any:
         """Array payloads broadcast as ONE compiled XLA program: the
@@ -427,7 +448,7 @@ class _MeshCollectives:
             rows = np.asarray(out)[0]
             return [rows for _ in range(self._n)]
 
-        return self._coll.run(self._myrank(), data, leader)
+        return self._coll.run(self._myrank(), data, leader, name="bcast")
 
     def gather(self, data: Any, root: int = 0) -> Optional[List[Any]]:
         """Uniform array payloads ride the compiled all_gather program
@@ -448,7 +469,7 @@ class _MeshCollectives:
             return [gathered if i == root else None
                     for i in range(self._n)]
 
-        return self._coll.run(self._myrank(), data, leader)
+        return self._coll.run(self._myrank(), data, leader, name="gather")
 
     def allgather(self, data: Any) -> List[Any]:
         """Array payloads of matching shape/dtype gather with ONE compiled
@@ -473,7 +494,8 @@ class _MeshCollectives:
             # not — same contract as the fallback path).
             return [list(gathered) for _ in range(self._n)]
 
-        return self._coll.run(self._myrank(), data, leader)
+        return self._coll.run(self._myrank(), data, leader,
+                              name="allgather")
 
     def scatter(self, data: Optional[List[Any]], root: int = 0) -> Any:
         """A uniform array list scatters by committing the stacked
@@ -501,7 +523,7 @@ class _MeshCollectives:
                                  NamedSharding(self._mesh, P("rank")))
             return self._per_rank(out)
 
-        return self._coll.run(self._myrank(), data, leader)
+        return self._coll.run(self._myrank(), data, leader, name="scatter")
 
     def alltoall(self, data: List[Any]) -> List[Any]:
         """Uniform payload matrices exchange with ONE compiled XLA
@@ -524,7 +546,8 @@ class _MeshCollectives:
             out = self._collective_fn("alltoall", "", False)(garr)
             return [list(row) for row in self._per_rank(out)]
 
-        return self._coll.run(self._myrank(), data, leader)
+        return self._coll.run(self._myrank(), data, leader,
+                              name="alltoall")
 
     def reduce(self, data: Any, root: int = 0, op: "OpLike" = "sum") -> Optional[Any]:
         self._check_rank(root)
@@ -562,7 +585,8 @@ class _MeshCollectives:
             out = self._collective_fn("reduce_scatter", op, det)(garr)
             return self._per_rank(out)
 
-        return self._coll.run(self._myrank(), data, leader)
+        return self._coll.run(self._myrank(), data, leader,
+                              name="reduce_scatter")
 
     def scan(self, data: Any, op: "OpLike" = "sum") -> Any:
         """Inclusive prefix reduction in rank order, as ONE compiled
@@ -613,7 +637,8 @@ class _MeshCollectives:
                 per = [None] + list(per[1:])  # rank 0: MPI_Exscan contract
             return per
 
-        return self._coll.run(self._myrank(), data, leader)
+        return self._coll.run(self._myrank(), data, leader,
+                              name="exscan" if exclusive else "scan")
 
 
 class XlaNetwork:
@@ -624,6 +649,10 @@ class XlaNetwork:
     # Rank threads share this process's address space, so RMA windows
     # over this driver support MPI_Win_shared_query (mpi_tpu.window).
     SUPPORTS_SHARED_WINDOWS = True
+    # ... and one process-global tracer buffer: the observe layer's
+    # trace collection writes the shared buffer once (rank threads
+    # appear as tid lanes) instead of gathering N duplicate copies.
+    SHARED_PROCESS_TRACER = True
 
     def __init__(self, n: Optional[int] = None,
                  devices: Optional[Sequence[Any]] = None,
@@ -749,8 +778,15 @@ class XlaNetwork:
         me = self._myrank()
         self._check_rank(dest)
         jax = _jax()
+        from ..utils import trace
+
+        tracing = trace.enabled()
         if isinstance(data, jax.Array):
-            payload = self._device_transfer(data, dest)
+            if tracing:
+                with trace.span("xla.transfer", dest=dest, tag=tag):
+                    payload = self._device_transfer(data, dest)
+            else:
+                payload = self._device_transfer(data, dest)
         elif isinstance(data, np.ndarray):
             payload = data.copy()
         elif isinstance(data, (bytes, str, int, float, bool, complex,
@@ -758,7 +794,15 @@ class XlaNetwork:
             payload = data  # immutable
         else:
             payload = copy.deepcopy(data)
-        self._pair(me, dest).send(tag, payload)
+        if tracing:
+            from ..api import _payload_bytes
+
+            trace.count(f"wire.xla.tx.bytes.peer{dest}",
+                        _payload_bytes(data))
+            with trace.span("xla.rendezvous_send", dest=dest, tag=tag):
+                self._pair(me, dest).send(tag, payload)
+        else:
+            self._pair(me, dest).send(tag, payload)
 
     def _device_transfer(self, data, dest: int):
         """Compiled device→device move of a jax.Array to ``dest``'s device.
@@ -786,7 +830,17 @@ class XlaNetwork:
     def receive(self, source: int, tag: int, out: Optional[Any] = None) -> Any:
         me = self._myrank()
         self._check_rank(source)
-        payload = self._pair(source, me).receive(tag)
+        from ..utils import trace
+
+        if trace.enabled():
+            from ..api import _payload_bytes
+
+            with trace.span("xla.recv_wait", source=source, tag=tag):
+                payload = self._pair(source, me).receive(tag)
+            trace.count(f"wire.xla.rx.bytes.peer{source}",
+                        _payload_bytes(payload))
+        else:
+            payload = self._pair(source, me).receive(tag)
         if out is not None and isinstance(out, np.ndarray) \
                 and isinstance(payload, np.ndarray) \
                 and out.shape == payload.shape and out.dtype == payload.dtype:

@@ -205,6 +205,21 @@ class ChaosEngine:
             print(f"mpi_tpu: chaos crash@{cfg.crash_at} — injected rank "
                   f"death (op {total}: {op} peer={peer} tag={tag})",
                   file=_sys.stderr)
+            # Flight-recorder postmortem: the dying rank's in-flight op
+            # and recent-op ring hit disk before the injected death, so
+            # the launcher's job report can name what it was doing
+            # (docs/OBSERVABILITY.md).
+            try:
+                from .observe import flight as _flight
+
+                path = _flight.dump(
+                    f"chaos crash@{cfg.crash_at} (op {total}: {op} "
+                    f"peer={peer} tag={tag})")
+                if path:
+                    print(f"mpi_tpu: observe: flight-recorder postmortem "
+                          f"written to {path}", file=_sys.stderr)
+            except BaseException:  # noqa: BLE001 - dying anyway
+                pass
             _sys.stderr.flush()
             os._exit(CRASH_EXIT_CODE)
         if "latency" in cfg.modes and \

@@ -72,13 +72,29 @@ def _next_tag_base(impl: Interface) -> int:
 
     Correct because collectives must be invoked in the same order on every
     rank (standard MPI requirement, documented in module doc)."""
+    return reserve_tag_blocks(impl, _TAGS_PER_COLLECTIVE)
+
+
+def reserve_tag_blocks(impl: Interface, tags_needed: int) -> int:
+    """Claim enough CONSECUTIVE collective tag blocks to cover
+    ``tags_needed`` tags; returns the base of the first block.
+
+    The standard block is ``_TAGS_PER_COLLECTIVE`` (4096) tags; a
+    collective whose schedule uses more (``allreduce_compressed_wire``
+    needs 4n tags, which overflows at world sizes > 1024 — ADVICE.md
+    round 5) must claim its true span or its tail tags would spill
+    into the NEXT collective's block and cross-collective traffic
+    could collide with no diagnostic. Consistent across ranks because
+    every rank invokes collectives in the same order with the same
+    world size."""
+    nblocks = max(1, -(-int(tags_needed) // _TAGS_PER_COLLECTIVE))
     lock = getattr(impl, "_coll_lock", None)
     if lock is None:
         lock = threading.Lock()
         setattr(impl, "_coll_lock", lock)
     with lock:
         seq = getattr(impl, "_coll_seq", 0)
-        setattr(impl, "_coll_seq", seq + 1)
+        setattr(impl, "_coll_seq", seq + nblocks)
     return COLL_TAG_BASE + seq * _TAGS_PER_COLLECTIVE
 
 
@@ -115,6 +131,16 @@ def combine(a: Any, b: Any, op) -> Any:
     if an.shape != bn.shape:
         raise MpiError(
             f"mpi_tpu: reduction shape mismatch across ranks: {an.shape} vs {bn.shape}")
+    from .utils import trace
+
+    if trace.enabled():
+        # The reduce step of every generic collective funnels through
+        # here — the per-stage counter the observe layer reads next to
+        # the wire spans (element count, not wall time: combine is
+        # memory-bound and the span machinery would dominate small
+        # payloads).
+        trace.count("coll.reduce.steps")
+        trace.count("coll.reduce.elems", float(an.size))
     out = np.asarray(fn(an, bn))
     if out.shape != an.shape:
         raise MpiError(

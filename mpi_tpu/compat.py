@@ -2568,6 +2568,11 @@ class Datatype:
         # only under this flag, so MPI.BYTE et al. keep the strict
         # no-silent-reinterpretation contract.
         self._struct = False
+        # Strictest component alignment in bytes — the MPI "alignment
+        # epsilon" Create_struct pads its default extent to (basics:
+        # the base dtype's own alignment; composites propagate the max
+        # of their components').
+        self._alignment = max(1, int(self._base.alignment))
         # Dense prefix layouts pack/unpack as one slice, no gather.
         n = self._offsets.size
         self._contig = bool(n == self._extent_elems
@@ -2645,6 +2650,7 @@ class Datatype:
         # vector-of-struct (the documented nesting spelling) must keep
         # viewing buffers as bytes, exactly like its component.
         out._struct = self._struct
+        out._alignment = self._alignment
         return out
 
     def Create_contiguous(self, count: int) -> "Datatype":
@@ -2757,11 +2763,18 @@ class Datatype:
                 "(a receive through this layout would be ambiguous)")
         names = ",".join(f"{bl}x{dt._name}@{disp}" for bl, disp, dt in
                          zip(blocklengths, displacements, datatypes))
+        # MPI's alignment epsilon (round-5 advisor): the default extent
+        # pads the ub to the strictest component alignment, as
+        # MPICH/mpi4py do — {double@0, char@8} gets extent 16, not 9 —
+        # so count>1 sends stride records like a compiler would.
+        # Create_resized remains the escape hatch for packed layouts.
+        align = max(dt._alignment for dt in datatypes)
+        raw_extent = max(int(offsets.max()) + 1, int(max(tails)))
         out = Datatype(np.uint8, offsets,
-                       extent=max(int(offsets.max()) + 1,
-                                  int(max(tails))),
+                       extent=-(-raw_extent // align) * align,
                        name=f"struct({names})", committed=False)
         out._struct = True
+        out._alignment = align
         return out
 
     def Create_resized(self, lb: int, extent: int) -> "Datatype":
@@ -2787,6 +2800,7 @@ class Datatype:
                        name=f"resized({extent})x{self._name}",
                        committed=False)
         out._struct = self._struct
+        out._alignment = self._alignment
         return out
 
     # -- explicit pack / unpack (MPI_Pack family) ---------------------------

@@ -44,7 +44,7 @@ from typing import Any, Tuple
 import numpy as np
 
 from .api import Interface, MpiError, exchange as _exchange
-from .collectives_generic import _next_tag_base
+from .collectives_generic import reserve_tag_blocks
 
 __all__ = ["allreduce_compressed_wire", "wire_compressed_eligible",
            "WIRE_QUANTIZED_MIN_BYTES", "quantize_np", "dequantize_np"]
@@ -191,7 +191,11 @@ def allreduce_compressed_wire(impl: Interface, data: Any,
     chunk = -(-m // (n * block)) * block       # elements per rank shard
     padded = np.zeros(n * chunk, np.float32)
     padded[:m] = flat
-    tag = _next_tag_base(impl)
+    # The two rotation phases use 4n tags (phase 1: tag..tag+2n-1,
+    # phase 2: tag+2n..tag+4n-1) — claim the TRUE span, not one 4096
+    # block, so world sizes > 1024 cannot spill into the next
+    # collective's tag block (ADVICE.md round 5).
+    tag = reserve_tag_blocks(impl, 4 * n)
 
     # Phase 1: quantize all n shards once, rotate each to its owner,
     # dequant-accumulate IN RANK ORDER (round order is timing-fixed,

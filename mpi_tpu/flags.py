@@ -45,6 +45,9 @@ __all__ = [
     "FLAG_OPTIMEOUT",
     "FLAG_CRC",
     "FLAG_CHAOS",
+    "FLAG_TRACE_OUT",
+    "FLAG_METRICS_OUT",
+    "FLAG_POSTMORTEM",
     "DEFAULT_PROTOCOL",
     "DEFAULT_INIT_TIMEOUT",
 ]
@@ -61,6 +64,11 @@ FLAG_PASSWORD = "mpi-password"
 FLAG_OPTIMEOUT = "mpi-optimeout"
 FLAG_CRC = "mpi-crc"
 FLAG_CHAOS = "mpi-chaos"
+# Observability extensions (docs/OBSERVABILITY.md): merged-trace sink,
+# per-rank metrics artifact, flight-recorder postmortem directory.
+FLAG_TRACE_OUT = "mpi-trace-out"
+FLAG_METRICS_OUT = "mpi-metrics-out"
+FLAG_POSTMORTEM = "mpi-postmortem"
 
 ENV_PREFIX = "MPI_TPU_"
 ENV_ADDR = ENV_PREFIX + "ADDR"
@@ -71,6 +79,9 @@ ENV_PASSWORD = ENV_PREFIX + "PASSWORD"
 ENV_OPTIMEOUT = ENV_PREFIX + "OPTIMEOUT"
 ENV_CRC = ENV_PREFIX + "CRC"
 ENV_CHAOS = ENV_PREFIX + "CHAOS"
+ENV_TRACE_OUT = ENV_PREFIX + "TRACE_OUT"
+ENV_METRICS_OUT = ENV_PREFIX + "METRICS_OUT"
+ENV_POSTMORTEM = ENV_PREFIX + "POSTMORTEM_DIR"
 
 DEFAULT_PROTOCOL = "tcp"  # flags.go:48 default
 # The reference's DurationFlag has no default (zero value); Network.Init then
@@ -150,6 +161,9 @@ class MpiFlags:
     optimeout: Optional[float] = None  # seconds; None = no op deadline
     crc: Optional[bool] = None         # per-frame CRC32 trailer wanted
     chaos: Optional[str] = None        # raw seed:rate:modes spec
+    trace_out: Optional[str] = None    # merged chrome-trace sink (rank 0)
+    metrics_out: Optional[str] = None  # per-rank metrics JSON artifact
+    postmortem: Optional[str] = None   # flight-recorder dump directory
 
     def as_argv(self) -> List[str]:
         """Render back to launcher-injectable argv (gompirun.go:77 ABI)."""
@@ -170,11 +184,18 @@ class MpiFlags:
             out += [f"--{FLAG_CRC}", "on" if self.crc else "off"]
         if self.chaos is not None:
             out += [f"--{FLAG_CHAOS}", self.chaos]
+        if self.trace_out is not None:
+            out += [f"--{FLAG_TRACE_OUT}", self.trace_out]
+        if self.metrics_out is not None:
+            out += [f"--{FLAG_METRICS_OUT}", self.metrics_out]
+        if self.postmortem is not None:
+            out += [f"--{FLAG_POSTMORTEM}", self.postmortem]
         return out
 
 
 _FLAG_NAMES = {FLAG_ADDR, FLAG_ALLADDR, FLAG_INITTIMEOUT, FLAG_PROTOCOL,
-               FLAG_PASSWORD, FLAG_OPTIMEOUT, FLAG_CRC, FLAG_CHAOS}
+               FLAG_PASSWORD, FLAG_OPTIMEOUT, FLAG_CRC, FLAG_CHAOS,
+               FLAG_TRACE_OUT, FLAG_METRICS_OUT, FLAG_POSTMORTEM}
 
 # Overridable argv source for tests (instead of mutating sys.argv).
 _argv_override: Optional[Sequence[str]] = None
@@ -268,6 +289,18 @@ def parse_flags(argv: Optional[Sequence[str]] = None,
     chaos = raw.get(FLAG_CHAOS, env.get(ENV_CHAOS))
     if chaos:
         flags.chaos = chaos
+
+    trace_out = raw.get(FLAG_TRACE_OUT, env.get(ENV_TRACE_OUT))
+    if trace_out:
+        flags.trace_out = trace_out
+
+    metrics_out = raw.get(FLAG_METRICS_OUT, env.get(ENV_METRICS_OUT))
+    if metrics_out:
+        flags.metrics_out = metrics_out
+
+    postmortem = raw.get(FLAG_POSTMORTEM, env.get(ENV_POSTMORTEM))
+    if postmortem:
+        flags.postmortem = postmortem
 
     return flags
 

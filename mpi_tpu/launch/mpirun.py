@@ -30,7 +30,8 @@ import time
 from typing import List, Optional, Sequence
 
 from ..flags import (FLAG_ADDR, FLAG_ALLADDR, FLAG_CHAOS, FLAG_CRC,
-                     FLAG_INITTIMEOUT, FLAG_OPTIMEOUT, FLAG_PASSWORD,
+                     FLAG_INITTIMEOUT, FLAG_METRICS_OUT, FLAG_OPTIMEOUT,
+                     FLAG_PASSWORD, FLAG_POSTMORTEM, FLAG_TRACE_OUT,
                      format_duration)
 
 DEFAULT_PORT_BASE = 6000  # gompirun.go:46
@@ -47,7 +48,10 @@ def build_commands(nprocs: int, prog: str, prog_args: Sequence[str],
                    host: str = "",
                    optimeout: Optional[float] = None,
                    crc: Optional[bool] = None,
-                   chaos: Optional[str] = None) -> List[List[str]]:
+                   chaos: Optional[str] = None,
+                   trace_out: Optional[str] = None,
+                   metrics_out: Optional[str] = None,
+                   postmortem_dir: Optional[str] = None) -> List[List[str]]:
     """Synthesize the per-rank command lines (the launcher<->program ABI).
 
     Pure function so tests can check the protocol without spawning."""
@@ -71,6 +75,12 @@ def build_commands(nprocs: int, prog: str, prog_args: Sequence[str],
             cmd += [f"--{FLAG_CRC}", "on" if crc else "off"]
         if chaos is not None:
             cmd += [f"--{FLAG_CHAOS}", chaos]
+        if trace_out is not None:
+            cmd += [f"--{FLAG_TRACE_OUT}", trace_out]
+        if metrics_out is not None:
+            cmd += [f"--{FLAG_METRICS_OUT}", metrics_out]
+        if postmortem_dir is not None:
+            cmd += [f"--{FLAG_POSTMORTEM}", postmortem_dir]
         cmds.append(cmd)
     return cmds
 
@@ -83,16 +93,45 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
            kill_grace: float = DEFAULT_KILL_GRACE,
            optimeout: Optional[float] = None,
            crc: Optional[bool] = None,
-           chaos: Optional[str] = None) -> int:
+           chaos: Optional[str] = None,
+           trace_out: Optional[str] = None,
+           metrics_out: Optional[str] = None,
+           postmortem_dir: Optional[str] = None) -> int:
     """Spawn all ranks concurrently, wait for all (gompirun.go:57-93).
 
     Returns the first non-zero child exit code, else 0. When any rank
     exits nonzero the survivors get SIGTERM immediately and SIGKILL
     after ``kill_grace`` seconds — a crashed rank ends the whole job in
-    seconds, never at the CI timeout."""
+    seconds, never at the CI timeout.
+
+    Observability (docs/OBSERVABILITY.md): ``trace_out`` injects
+    ``--mpi-trace-out`` (and ``MPI_TPU_TRACE=1``) into every rank so
+    rank 0 writes one merged clock-aligned chrome trace at Finalize;
+    ``metrics_out`` injects the per-rank metrics artifact path;
+    ``postmortem_dir`` (defaulted automatically under ``chaos``)
+    injects the flight-recorder dump directory, and after a failed job
+    the survivors' and victims' dumps are folded into
+    ``<dir>/job_postmortem.json`` with the dead rank's last in-flight
+    operation echoed to stderr."""
+    if postmortem_dir is None:
+        # A user-set env dir wins over inventing a temp dir (the
+        # injected argv flag would otherwise shadow the env in the
+        # children — argv beats env in the observe config).
+        from ..flags import ENV_POSTMORTEM
+
+        postmortem_dir = os.environ.get(ENV_POSTMORTEM) or None
+    auto_pm_dir = chaos is not None and postmortem_dir is None
+    if auto_pm_dir:
+        import tempfile
+
+        postmortem_dir = tempfile.mkdtemp(prefix="mpi-postmortem-")
+        print(f"mpirun: chaos active — flight-recorder postmortems in "
+              f"{postmortem_dir}", file=sys.stderr)
     cmds = build_commands(nprocs, prog, prog_args, port_base=port_base,
                           timeout=timeout, password=password,
-                          optimeout=optimeout, crc=crc, chaos=chaos)
+                          optimeout=optimeout, crc=crc, chaos=chaos,
+                          trace_out=trace_out, metrics_out=metrics_out,
+                          postmortem_dir=postmortem_dir)
     procs: List[subprocess.Popen] = []
     child_env = dict(os.environ if env is None else env)
     # Children run with the PROGRAM's cwd on their sys.path, not this
@@ -105,6 +144,10 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
     if pkg_root not in existing.split(os.pathsep):
         child_env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
                                    if existing else pkg_root)
+    if trace_out is not None:
+        # Span recording must be live in every rank for the merged
+        # trace to have content; the flag names only the sink.
+        child_env.setdefault("MPI_TPU_TRACE", "1")
     for i, cmd in enumerate(cmds):
         # stdio passthrough, as gompirun pipes child output (gompirun.go:86-88)
         procs.append(subprocess.Popen(cmd, env=child_env))
@@ -143,7 +186,73 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
             killed = True
         if pending:
             time.sleep(0.05)
+    if first_bad and postmortem_dir:
+        _collect_job_postmortem(postmortem_dir)
+    if auto_pm_dir:
+        # Don't leak an auto-created temp dir: a clean chaos run (or a
+        # failure that produced no dumps) leaves it empty — remove it.
+        # rmdir refuses on non-empty, which is exactly the keep case.
+        try:
+            os.rmdir(postmortem_dir)
+        except OSError:
+            pass
     return first_bad or 0
+
+
+def _collect_job_postmortem(pm_dir: str) -> Optional[str]:
+    """Fold every rank's flight-recorder dump into one job report and
+    echo each dead/failed rank's last in-flight operation — the "what
+    was each rank doing" snapshot a typed failure now ships with."""
+    import glob
+    import json
+
+    dumps = sorted(glob.glob(os.path.join(pm_dir, "postmortem-*.json")))
+    if not dumps:
+        print(f"mpirun: no flight-recorder dumps found in {pm_dir}",
+              file=sys.stderr)
+        return None
+    ranks = {}
+    for path in dumps:
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"mpirun: unreadable postmortem {path}: {exc}",
+                  file=sys.stderr)
+            continue
+        ranks[str(snap.get("rank"))] = snap
+    report = {"version": 1, "ranks": ranks}
+    out = os.path.join(pm_dir, "job_postmortem.json")
+    try:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=1)
+    except OSError as exc:
+        print(f"mpirun: cannot write job postmortem: {exc}",
+              file=sys.stderr)
+        return None
+    for r in sorted(ranks):
+        snap = ranks[r]
+        inflight = snap.get("in_flight", [])
+        if inflight:
+            # Insertion order = start order: the LAST entry is the op
+            # started most recently — "what the rank was doing" — not
+            # a long-parked background op. Others are counted; the
+            # full list is in the JSON (observe postmortem renders it).
+            ent = inflight[-1]
+            peer = ent.get("peer")
+            where = "" if peer in (None, -1) else \
+                f"(peer={peer}, tag={ent.get('tag')}) "
+            more = (f" (+{len(inflight) - 1} more in flight)"
+                    if len(inflight) > 1 else "")
+            print(f"mpirun: rank {r}: {snap.get('reason', '?')}; last "
+                  f"in-flight op: {ent.get('op', '?')} {where}"
+                  f"{ent.get('elapsed_us', 0):.0f}µs in{more}",
+                  file=sys.stderr)
+        else:
+            print(f"mpirun: rank {r}: {snap.get('reason', '?')}; no "
+                  f"operation in flight", file=sys.stderr)
+    print(f"mpirun: job postmortem written to {out}", file=sys.stderr)
+    return out
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -167,6 +276,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--chaos", default=None,
                         help="chaos fault-injection spec seed:rate:modes "
                              "injected as --mpi-chaos")
+    parser.add_argument("--trace-out", default=None,
+                        help="merged chrome-trace path (injected as "
+                             "--mpi-trace-out; enables MPI_TPU_TRACE=1 "
+                             "in every rank; rank 0 writes the merged "
+                             "clock-aligned trace at Finalize)")
+    parser.add_argument("--metrics-out", default=None,
+                        help="per-rank metrics JSON path (injected as "
+                             "--mpi-metrics-out; '{rank}' substitutes "
+                             "the rank, else '.rank<r>' is appended)")
+    parser.add_argument("--postmortem-dir", default=None,
+                        help="flight-recorder dump directory (injected "
+                             "as --mpi-postmortem; defaults to a temp "
+                             "dir when --chaos is active; failed jobs "
+                             "get a collected job_postmortem.json)")
     parser.add_argument("--kill-grace", type=float,
                         default=DEFAULT_KILL_GRACE,
                         help="seconds between SIGTERM and SIGKILL when "
@@ -183,7 +306,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   port_base=args.port_base, timeout=args.timeout,
                   password=args.password, kill_grace=args.kill_grace,
                   optimeout=args.optimeout, crc=args.crc,
-                  chaos=args.chaos)
+                  chaos=args.chaos, trace_out=args.trace_out,
+                  metrics_out=args.metrics_out,
+                  postmortem_dir=args.postmortem_dir)
 
 
 if __name__ == "__main__":

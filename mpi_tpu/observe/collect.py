@@ -1,0 +1,292 @@
+"""Distributed trace collection — per-rank buffers → one aligned trace.
+
+The process-local tracer (:mod:`mpi_tpu.utils.trace`) records spans
+into a buffer that dies with its rank. This module is the job-wide
+half: at Finalize (or on demand), **rank 0 gathers every rank's buffer
+over the existing transport**, estimates each rank's clock offset with
+a ping-style exchange, and merges everything into ONE Perfetto /
+chrome://tracing JSON with one track (pid) per rank, send/receive span
+pairs clock-aligned to rank 0's timeline.
+
+Protocol (tags in the user band, chosen < 2**32 - 2**21 so the hybrid
+driver's composed cross-host tags carry them; active only inside
+finalize, after user traffic has drained):
+
+  1. ping × 3 per rank: rank 0 records ``t0``, sends an empty frame,
+     the peer replies with its ``time.time_ns()``, rank 0 records
+     ``t1``. The minimum-RTT sample gives
+     ``offset = t_peer - (t0 + t1) / 2`` (NTP's symmetric-path
+     estimate; on localhost |offset| is bounded by the RTT).
+  2. bundle: the peer sends its JSON bundle — span events, counters,
+     the tracer's wall anchor, collective-entry records, and a flight
+     summary.
+
+Rank 0 shifts every event by ``anchor - offset`` onto its own
+timeline, emits per-rank process-name metadata tracks, and computes
+**cross-process straggler skew** from the clock-aligned collective
+entries. Every receive is bounded (default 60 s,
+``MPI_TPU_OBSERVE_TIMEOUT``) so a crashed rank stalls collection, not
+the job: missing ranks are noted in the merged metadata and skipped.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils import trace
+from . import flight, metrics
+
+__all__ = ["OBS_TAG_BASE", "collect_and_merge", "local_bundle",
+           "merge_bundles", "estimate_offsets"]
+
+# 0xB5E00000 < 2**32 - 2**21: legal as a hybrid cross-host composed tag.
+OBS_TAG_BASE = 0xB5E00000
+_T_PING = OBS_TAG_BASE + 1
+_T_PONG = OBS_TAG_BASE + 2
+_T_BUNDLE = OBS_TAG_BASE + 3
+_PINGS = 3
+
+
+def _timeout() -> float:
+    try:
+        return float(os.environ.get("MPI_TPU_OBSERVE_TIMEOUT", "60"))
+    except ValueError:
+        return 60.0
+
+
+def _bounded(fn: Callable[[], Any], timeout: float, what: str) -> Any:
+    """Run a blocking transport call with a hard deadline: a crashed
+    peer must stall trace collection, not finalize. The worker is a
+    daemon thread (xla rank bindings inherit while run_spmd is active);
+    on timeout it is abandoned — the transport teardown that follows
+    finalize unblocks it."""
+    box: List[Any] = [None]
+    err: List[Optional[BaseException]] = [None]
+    done = threading.Event()
+
+    def run() -> None:
+        try:
+            box[0] = fn()
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            err[0] = exc
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True, name="mpi-observe")
+    t.start()
+    if not done.wait(timeout):
+        raise TimeoutError(f"mpi_tpu: observe {what} timed out "
+                           f"after {timeout:g}s")
+    if err[0] is not None:
+        raise err[0]
+    return box[0]
+
+
+def local_bundle(rank: int) -> Dict[str, Any]:
+    """This rank's contribution to the merged trace."""
+    return {
+        "rank": rank,
+        "pid": os.getpid(),
+        "anchor_ns": trace.wall_anchor_ns(),
+        "events": trace.events(),
+        "counters": trace.counters(),
+        "dropped": trace.dropped(),
+        "collective_entries": metrics.collective_entries(),
+        "flight": {"op_counts": flight.snapshot()["op_counts"]},
+    }
+
+
+def estimate_offsets(samples: List[Dict[str, float]]) -> Dict[str, float]:
+    """min-RTT offset estimate from ping samples
+    [{t0_ns, t1_ns, peer_ns}, ...] → {offset_ns, rtt_ns}."""
+    best = min(samples, key=lambda s: s["t1_ns"] - s["t0_ns"])
+    rtt = best["t1_ns"] - best["t0_ns"]
+    offset = best["peer_ns"] - (best["t0_ns"] + best["t1_ns"]) / 2.0
+    return {"offset_ns": offset, "rtt_ns": rtt}
+
+
+def _aligned_entries(bundles: Dict[int, Dict[str, Any]],
+                     offsets: Dict[int, Dict[str, float]]
+                     ) -> List[Dict[str, Any]]:
+    """Cross-process straggler skew: group collective-entry records by
+    (name, seq) and compare clock-aligned arrival times across ranks."""
+    by_key: Dict[tuple, List[tuple]] = {}
+    for r, b in bundles.items():
+        off = offsets.get(r, {}).get("offset_ns", 0.0)
+        for name, seq, wall_ns in b.get("collective_entries", []):
+            by_key.setdefault((name, seq), []).append((r, wall_ns - off))
+    nranks = len(bundles)
+    rows = []
+    for (name, seq), arrivals in by_key.items():
+        if len(arrivals) < max(2, nranks):
+            continue  # a rank missed it (crash/cap) — skew undefined
+        ts = [t for _, t in arrivals]
+        skew_us = (max(ts) - min(ts)) / 1e3
+        slowest = max(arrivals, key=lambda a: a[1])[0]
+        rows.append({"collective": name, "seq": seq,
+                     "skew_us": skew_us, "slowest_rank": slowest})
+    rows.sort(key=lambda r: -r["skew_us"])
+    return rows
+
+
+def merge_bundles(bundles: Dict[int, Dict[str, Any]],
+                  offsets: Dict[int, Dict[str, float]],
+                  missing: Optional[List[int]] = None) -> Dict[str, Any]:
+    """Merge per-rank bundles into one chrome-trace document: pid =
+    rank (one track per rank), timestamps clock-aligned to rank 0."""
+    base = None
+    events: List[Dict[str, Any]] = []
+    for r in sorted(bundles):
+        b = bundles[r]
+        off = offsets.get(r, {}).get("offset_ns", 0.0)
+        events.append({"name": "process_name", "ph": "M", "pid": r,
+                       "args": {"name": f"rank {r} (pid {b['pid']})"}})
+        for e in b["events"]:
+            abs_us = e["ts_us"] + (b["anchor_ns"] - off) / 1e3
+            if base is None or abs_us < base:
+                base = abs_us
+            events.append({
+                "name": e["name"],
+                "ph": "X",
+                "ts": abs_us,
+                "dur": e["dur_us"],
+                "pid": r,
+                "tid": e.get("thread", "main"),
+                "args": {k: v for k, v in e.items()
+                         if k not in ("name", "ts_us", "dur_us", "thread")},
+            })
+    # Rebase to the earliest event so viewers don't render epoch offsets.
+    base = base or 0.0
+    for e in events:
+        if e["ph"] == "X":
+            e["ts"] -= base
+    stragglers = _aligned_entries(bundles, offsets)
+    return {
+        "traceEvents": events,
+        "metadata": {
+            "ranks": sorted(bundles),
+            "missing_ranks": sorted(missing or []),
+            "clock_offsets_us": {str(r): o["offset_ns"] / 1e3
+                                 for r, o in offsets.items()},
+            "clock_rtt_us": {str(r): o["rtt_ns"] / 1e3
+                             for r, o in offsets.items()},
+            "counters_by_rank": {str(r): b["counters"]
+                                 for r, b in bundles.items()},
+            "dropped_by_rank": {str(r): b["dropped"]
+                                for r, b in bundles.items()},
+            "stragglers": stragglers[:64],
+        },
+    }
+
+
+def collect_and_merge(impl: Any, out_path: str) -> Optional[str]:
+    """The Finalize-time gather. COLLECTIVE: every rank must call this
+    (the facade's finalize does, when ``--mpi-trace-out`` is set on all
+    ranks). Rank 0 writes the merged JSON and returns its path; other
+    ranks return None.
+
+    Drivers whose ranks are THREADS of one process (xla; hybrid's
+    local tier) share one tracer buffer — a per-rank gather would
+    duplicate every span into every track and fabricate straggler
+    rows. Such drivers declare ``SHARED_PROCESS_TRACER`` and rank 0
+    writes the shared buffer once, one process track with per-rank
+    thread lanes (tid = rank-thread name); cross-rank skew for them
+    comes from the exact in-process session stamps instead
+    (:func:`mpi_tpu.observe.metrics.note_session_skew`)."""
+    rank, size = impl.rank(), impl.size()
+    timeout = _timeout()
+    if size == 1 or getattr(impl, "SHARED_PROCESS_TRACER", False):
+        if rank != 0:
+            return None
+        doc = merge_bundles({0: local_bundle(0)},
+                            {0: {"offset_ns": 0.0, "rtt_ns": 0.0}})
+        if size > 1:
+            doc["metadata"]["shared_process_tracer"] = True
+            doc["metadata"]["ranks"] = list(range(size))
+        _write(out_path, doc)
+        return out_path
+
+    # The gather's own waits are bounded by _bounded; the transport's
+    # per-op deadline (--mpi-optimeout, often a few seconds) must not
+    # preempt them — a rank legitimately waits through earlier ranks'
+    # turns far longer than any op deadline. Suspend it for the
+    # collection and restore on the way out.
+    had_optimeout = hasattr(impl, "optimeout")
+    saved_optimeout = getattr(impl, "optimeout", None)
+    if had_optimeout:
+        impl.optimeout = None
+    try:
+        return _gather(impl, rank, size, timeout, out_path)
+    finally:
+        if had_optimeout:
+            impl.optimeout = saved_optimeout
+
+
+def _gather(impl: Any, rank: int, size: int, timeout: float,
+            out_path: str) -> Optional[str]:
+    if rank != 0:
+        # The gather is serial from rank 0's side: rank k may
+        # legitimately wait through k-1 earlier ranks' turns before
+        # its ping arrives, so the first wait scales with world size
+        # (rank 0's own per-step waits stay at one `timeout`, which is
+        # what bounds the cost of a dead rank).
+        first_wait = timeout * max(1, size - 1)
+        _bounded(lambda: impl.receive(0, _T_PING), first_wait,
+                 "ping wait")
+        _bounded(lambda: impl.send(
+            str(time.time_ns()).encode("ascii"), 0, _T_PONG),
+            timeout, "pong send")
+        for _ in range(_PINGS - 1):
+            _bounded(lambda: impl.receive(0, _T_PING), timeout, "ping wait")
+            _bounded(lambda: impl.send(
+                str(time.time_ns()).encode("ascii"), 0, _T_PONG),
+                timeout, "pong send")
+        payload = json.dumps(local_bundle(rank)).encode("utf-8")
+        _bounded(lambda: impl.send(payload, 0, _T_BUNDLE), timeout,
+                 "bundle send")
+        return None
+
+    bundles = {0: local_bundle(0)}
+    offsets: Dict[int, Dict[str, float]] = {
+        0: {"offset_ns": 0.0, "rtt_ns": 0.0}}
+    missing: List[int] = []
+    for src in range(1, size):
+        try:
+            samples = []
+            for _ in range(_PINGS):
+                t0 = time.time_ns()
+                # Bounded like the receives: a dead rank-thread on the
+                # in-process drivers would park a blocking rendezvous
+                # send forever.
+                _bounded(lambda: impl.send(b"", src, _T_PING), timeout,
+                         "ping send")
+                peer_ns = int(bytes(_bounded(
+                    lambda: impl.receive(src, _T_PONG), timeout,
+                    "pong")).decode("ascii"))
+                t1 = time.time_ns()
+                samples.append({"t0_ns": t0, "t1_ns": t1,
+                                "peer_ns": peer_ns})
+            offsets[src] = estimate_offsets(samples)
+            raw = _bounded(lambda: impl.receive(src, _T_BUNDLE), timeout,
+                           "bundle")
+            bundles[src] = json.loads(bytes(raw).decode("utf-8"))
+        except Exception as exc:  # noqa: BLE001 - skip dead ranks
+            import sys as _sys
+
+            print(f"mpi_tpu: observe: skipping rank {src} in trace "
+                  f"collection: {exc}", file=_sys.stderr)
+            missing.append(src)
+    doc = merge_bundles(bundles, offsets, missing=missing)
+    _write(out_path, doc)
+    return out_path
+
+
+def _write(path: str, doc: Dict[str, Any]) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)

@@ -1,0 +1,195 @@
+"""Flight recorder — a bounded ring of the last N operations per rank.
+
+The chaos layer (PR 2) made failures *typed*; this module makes them
+*narrated*. Every facade operation registers itself here (begin →
+in-flight table, end → completed ring with duration and outcome), so
+when a rank dies — a chaos ``crash@K``, an ``abort()``, or the first
+fatal typed error (``RemoteAbortError``/``DeadlineError``/
+``PeerDeadError``/``ChecksumError``) — a **postmortem JSON** snapshot
+of "what this rank was doing" lands on disk: the in-flight operations
+at the moment of death plus the completed-op ring leading up to it.
+``mpirun`` then folds every rank's dump into one job report
+(docs/OBSERVABILITY.md).
+
+Cost doctrine: recording is two ``perf_counter_ns`` calls, one dict
+store and one deque append per operation — noise against even the
+fastest transport op (~60 µs xla bounce) — and a single module-bool
+check when disabled (``MPI_TPU_FLIGHT=0``). Dumping only happens on
+the way down and only when a postmortem directory is configured
+(``--mpi-postmortem`` / ``MPI_TPU_POSTMORTEM_DIR``); otherwise
+``dump()`` is a no-op.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["enabled", "begin", "end", "dump", "set_rank", "snapshot",
+           "op_durations", "configure", "reset_for_testing"]
+
+_DEFAULT_CAP = 256
+# Per-op duration accumulators keep at most this many samples for
+# p50/p99 (first-K; counts keep accumulating past the cap).
+_DURATIONS_CAP = 4096
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("MPI_TPU_FLIGHT", "1").strip().lower() not in (
+        "0", "f", "false", "off", "no", "n")
+
+
+def _env_cap() -> int:
+    try:
+        return max(8, int(os.environ.get("MPI_TPU_FLIGHT_N", _DEFAULT_CAP)))
+    except ValueError:
+        return _DEFAULT_CAP
+
+
+class _Flight:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.cap = _env_cap()
+        self.ring: deque = deque(maxlen=self.cap)
+        self.inflight: Dict[int, Dict[str, Any]] = {}
+        self.ids = itertools.count(1)
+        self.rank: Optional[int] = None
+        self.durations: Dict[str, List[float]] = {}
+        self.op_counts: Dict[str, int] = {}
+        self.dumped = False
+        self.dump_lock = threading.Lock()
+
+
+_fl = _Flight()
+enabled = _env_enabled()
+
+
+def configure(on: Optional[bool] = None, cap: Optional[int] = None) -> None:
+    """Runtime switch (tests; programs use the env vars)."""
+    global enabled
+    if on is not None:
+        enabled = bool(on)
+    if cap is not None:
+        with _fl.lock:
+            _fl.cap = max(8, int(cap))
+            _fl.ring = deque(_fl.ring, maxlen=_fl.cap)
+
+
+def set_rank(rank: int) -> None:
+    _fl.rank = int(rank)
+
+
+def begin(op: str, peer: int, tag: int, nbytes: int = 0) -> int:
+    """Register an operation as in-flight; returns a token for end()."""
+    tok = next(_fl.ids)
+    _fl.inflight[tok] = {
+        "op": op,
+        "peer": peer,
+        "tag": tag,
+        "bytes": nbytes,
+        "t0_ns": time.perf_counter_ns(),
+        "wall_ns": time.time_ns(),
+        "thread": threading.current_thread().name,
+    }
+    return tok
+
+
+def end(tok: int, state: str = "ok") -> None:
+    """Move an in-flight operation to the completed ring."""
+    ent = _fl.inflight.pop(tok, None)
+    if ent is None:
+        return
+    dur_us = (time.perf_counter_ns() - ent["t0_ns"]) / 1e3
+    ent["dur_us"] = dur_us
+    ent["state"] = state
+    del ent["t0_ns"]
+    with _fl.lock:
+        _fl.ring.append(ent)
+        _fl.op_counts[ent["op"]] = _fl.op_counts.get(ent["op"], 0) + 1
+        samples = _fl.durations.setdefault(ent["op"], [])
+        if len(samples) < _DURATIONS_CAP:
+            samples.append(dur_us)
+
+
+def op_durations() -> Dict[str, List[float]]:
+    """Per-op duration samples (µs) with total counts — the metrics
+    layer's p50/p99 source. Returns {op: [samples...]}; counts via
+    snapshot()."""
+    with _fl.lock:
+        return {k: list(v) for k, v in _fl.durations.items()}
+
+
+def snapshot(reason: str = "") -> Dict[str, Any]:
+    """The postmortem payload (also embedded in metrics artifacts)."""
+    now_ns = time.perf_counter_ns()
+    inflight = []
+    for ent in list(_fl.inflight.values()):
+        e = dict(ent)
+        # A concurrent end() may have completed this op between the
+        # values() snapshot and the copy (it del-s t0_ns) — treat it
+        # as no-longer-in-flight rather than racing the mutation.
+        t0 = e.pop("t0_ns", None)
+        if t0 is None:
+            continue
+        e["elapsed_us"] = (now_ns - t0) / 1e3
+        inflight.append(e)
+    with _fl.lock:
+        recent = list(_fl.ring)
+        counts = dict(_fl.op_counts)
+    return {
+        "version": 1,
+        "rank": _fl.rank,
+        "pid": os.getpid(),
+        "wall_ns": time.time_ns(),
+        "reason": reason,
+        "in_flight": inflight,
+        "recent": recent,
+        "op_counts": counts,
+    }
+
+
+def _postmortem_dir() -> Optional[str]:
+    from . import postmortem_dir
+
+    return postmortem_dir()
+
+
+def dump(reason: str, path: Optional[str] = None,
+         force: bool = False) -> Optional[str]:
+    """Write this rank's postmortem JSON; returns the path (None when no
+    postmortem directory is configured). First fatal error wins — later
+    cascade failures (every op on a dead peer poisons) don't re-dump
+    unless ``force``."""
+    with _fl.dump_lock:
+        if _fl.dumped and not force:
+            return None
+        if path is None:
+            d = _postmortem_dir()
+            if not d:
+                return None
+            try:
+                os.makedirs(d, exist_ok=True)
+            except OSError:
+                return None
+            rank = _fl.rank if _fl.rank is not None else "unknown"
+            path = os.path.join(
+                d, f"postmortem-rank{rank}-pid{os.getpid()}.json")
+        snap = snapshot(reason)
+        try:
+            with open(path, "w") as f:
+                json.dump(snap, f, indent=1)
+        except OSError:
+            return None
+        _fl.dumped = True
+        return path
+
+
+def reset_for_testing() -> None:
+    global enabled
+    _fl.__init__()
+    enabled = _env_enabled()

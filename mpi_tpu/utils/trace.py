@@ -40,6 +40,7 @@ __all__ = [
     "dropped",
     "clear",
     "dump_chrome_trace",
+    "wall_anchor_ns",
     "profile",
 ]
 
@@ -53,6 +54,11 @@ class _Tracer:
         self.counters: Dict[str, float] = {}
         self.enabled = bool(os.environ.get("MPI_TPU_TRACE"))
         self.dropped = 0
+        # Span timestamps are perf_counter_ns (monotonic, arbitrary
+        # origin). This anchor maps them onto the wall clock —
+        # wall_ns ≈ ts_ns + anchor — which is what the job-wide merge
+        # (mpi_tpu.observe.collect) aligns across ranks.
+        self.wall_anchor_ns = time.time_ns() - time.perf_counter_ns()
 
     def add_event(self, ev: Dict[str, Any]) -> None:
         with self.lock:
@@ -123,6 +129,13 @@ def dropped() -> int:
     """Events discarded because the buffer cap was hit."""
     with _tracer.lock:
         return _tracer.dropped
+
+
+def wall_anchor_ns() -> int:
+    """This process's perf_counter→wall-clock anchor: add it to a
+    span's ``ts_us * 1e3`` to place the span on the wall clock (the
+    cross-rank merge substrate; see :mod:`mpi_tpu.observe.collect`)."""
+    return _tracer.wall_anchor_ns
 
 
 def clear() -> None:
