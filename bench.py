@@ -656,6 +656,7 @@ def _hybrid_allreduce_child() -> int:
 
     from mpi_tpu.backends.hybrid import HybridNetwork, run_spmd_hybrid
     from mpi_tpu.backends.tcp import TcpNetwork
+    from mpi_tpu.observe import metrics
     from mpi_tpu.utils import trace
 
     # Tier spans (VERDICT r3 item 5): the engine's allreduce records
@@ -686,6 +687,7 @@ def _hybrid_allreduce_child() -> int:
         s.close()
 
     tier_evs: list = []   # spans from the 1 MiB variant ONLY
+    skew_rows: list = []  # (name, skew_us, slowest) — 1 MiB rounds
 
     def fn_for(net):
         def main():
@@ -700,6 +702,10 @@ def _hybrid_allreduce_child() -> int:
                         # before the 8 MiB variants pollute the buffer.
                         tier_evs.extend(trace.events())
                         trace.clear()
+                        # Arrival-skew rows accumulate in the metrics
+                        # module (one process, one clock): the slice
+                        # recorded so far is the 1 MiB variant's.
+                        skew_rows.extend(metrics.session_skews())
                     if pipeline_min is None:
                         os.environ.pop("MPI_TPU_HYBRID_PIPELINE_MIN",
                                        None)
@@ -783,6 +789,24 @@ def _hybrid_allreduce_child() -> int:
             rec[f"hybrid_allreduce_1MiB_tier_{tier}_p50_us"] = round(
                 statistics.median(durs), 1)
             rec[f"hybrid_allreduce_tier_{tier}_spans"] = len(durs)
+    # Straggler table over the 1 MiB rounds: per-round arrival skew of
+    # the 32 rank threads at the collective's entry barrier (recorded by
+    # the xla session while the tracer is on). Thread-scheduling jitter,
+    # not an engine signal — the _skew_ keys are excluded from the
+    # regression check.
+    ar_rows = [r for r in skew_rows if "allreduce" in r[0]] or skew_rows
+    if ar_rows:
+        skews = sorted(s for _, s, _ in ar_rows)
+        worst = max(ar_rows, key=lambda r: r[1])
+        rec["hybrid_allreduce_1MiB_skew_p50_us"] = round(
+            statistics.median(skews), 1)
+        rec["hybrid_allreduce_1MiB_skew_max_us"] = round(worst[1], 1)
+        rec["hybrid_allreduce_1MiB_skew_slowest_rank"] = worst[2]
+        rec["hybrid_allreduce_1MiB_skew_rounds"] = len(ar_rows)
+        rec["hybrid_allreduce_1MiB_stragglers"] = [
+            {"collective": n, "skew_us": round(s, 1),
+             "slowest_rank": sl}
+            for n, s, sl in sorted(ar_rows, key=lambda r: -r[1])[:5]]
     print(json.dumps(rec))
     return 0
 
@@ -1402,6 +1426,11 @@ _COMPACT_KEYS = (
 )
 _LINE_BUDGET = 1600  # bytes; safely inside the driver's capture tail
 
+# --compare BASE.json: explicit baseline artifact for the regression
+# check, overriding the committed-HEAD default (tools/bench_gate.py and
+# the nightly workflow diff two arbitrary rounds this way).
+_COMPARE_BASE: Optional[str] = None
+
 
 def _regression_check(full: dict, prior: dict) -> None:
     """Mutate ``full`` with a self-regression verdict against the last
@@ -1505,6 +1534,7 @@ def _regression_check(full: dict, prior: dict) -> None:
                 or b.startswith("host_")  # box diagnosis, not a result
                 or b.endswith("_dram_traffic_x")
                 or b.endswith("_spread_us")
+                or "_skew_" in b  # straggler diagnostics, not results
                 # A/B of the DEMOTED pipeline lever: measured
                 # noise-dominated on this box (PERF_NOTES.md) — its
                 # swing is not a regression signal.
@@ -1577,9 +1607,25 @@ def _emit(full: dict) -> None:
     headline."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_FULL.json")
-    prior = _committed_artifact(os.path.dirname(path))
+    prior: Optional[dict] = None
+    if _COMPARE_BASE is not None:
+        try:
+            with open(_COMPARE_BASE) as f:
+                rec = json.load(f)
+            prior = rec if isinstance(rec, dict) else None
+        except (OSError, ValueError):
+            prior = None
+        if prior is None:
+            full["regressions_vs"] = (
+                f"unreadable --compare base: {_COMPARE_BASE}")
+    else:
+        prior = _committed_artifact(os.path.dirname(path))
     if prior is not None:
         _regression_check(full, prior)
+        if _COMPARE_BASE is not None and "regressions" in full:
+            # The incomparable early-return keeps its own verdict; only
+            # a completed check gets relabelled with the explicit base.
+            full["regressions_vs"] = f"--compare {_COMPARE_BASE}"
     try:
         with open(path, "w") as f:
             json.dump(full, f, indent=1)
@@ -1647,6 +1693,14 @@ def main() -> int:
         return _allreduce_child(sys.argv[idx + 1])
     if "--_hybrid-allreduce-child" in sys.argv:
         return _hybrid_allreduce_child()
+    global _COMPARE_BASE
+    if "--compare" in sys.argv:
+        idx = sys.argv.index("--compare")
+        if idx + 1 >= len(sys.argv):
+            print("usage: bench.py [--compare BASE.json] ...",
+                  file=sys.stderr)
+            return 2
+        _COMPARE_BASE = sys.argv[idx + 1]
     # --platform cpu[:N] pins the JAX platform before any device query;
     # the driver runs with no flag and gets the real chip.
     platform_arg: Optional[str] = None

@@ -257,7 +257,8 @@ def _chaos_wire_send(sock, lock: threading.Lock, kind: int, tag: int,
 
 def _send_frame(sock, lock: threading.Lock, kind: int,
                 tag: int, payload: bytes = b"",
-                payload2=None, crc: bool = False, fault=None) -> None:
+                payload2=None, crc: bool = False, fault=None,
+                stages=None) -> None:
     """Write one wire frame. With ``payload2`` (the codec's
     :func:`~mpi_tpu.utils.serialize.encode_parts` view) the frame body
     is ``payload + payload2`` scatter-gathered straight from the
@@ -268,7 +269,11 @@ def _send_frame(sock, lock: threading.Lock, kind: int,
     integrity option takes the Python write path; with it off this
     function is byte-identical to the pre-CRC implementation).
     ``fault`` (a :class:`mpi_tpu.chaos.WireFault`) routes the frame
-    through the chaos wire plane instead."""
+    through the chaos wire plane instead. ``stages`` (a caller-zeroed
+    ``(ctypes.c_uint64 * 4)`` scratch) makes the native engine
+    accumulate per-stage ns/counts — assemble ns, writev ns, writev
+    calls, bytes — for the tracer's ``wire.write.*`` child spans; only
+    the native path fills it (``stages[2]`` stays 0 otherwise)."""
     use_crc = crc and kind == KIND_DATA and not isinstance(sock, ShmConn)
     if fault is not None and fault.any() and not isinstance(sock, ShmConn):
         _chaos_wire_send(sock, lock, kind, tag, payload, payload2,
@@ -313,7 +318,7 @@ def _send_frame(sock, lock: threading.Lock, kind: int,
                 while True:
                     rc = lib.wc_send_frame2(
                         sock.fileno(), kind, tag, payload, len(payload),
-                        ptr, n2, ctypes.byref(progress))
+                        ptr, n2, ctypes.byref(progress), stages)
                     if rc != -_errno.EINTR:
                         break
             del keep
@@ -322,7 +327,7 @@ def _send_frame(sock, lock: threading.Lock, kind: int,
                 while True:
                     rc = lib.wc_send_frame(sock.fileno(), kind, tag,
                                            payload, len(payload),
-                                           ctypes.byref(progress))
+                                           ctypes.byref(progress), stages)
                     if rc != -_errno.EINTR:
                         break
         if rc == 0:
@@ -346,7 +351,7 @@ def _send_frame(sock, lock: threading.Lock, kind: int,
 
 
 def _recv_exact(sock: socket.socket, n: int,
-                midframe: bool = False) -> bytearray:
+                midframe: bool = False, stages=None) -> bytearray:
     """Read exactly ``n`` bytes. Returns the freshly-owned bytearray
     (no defensive copy — the caller is the sole owner, which lets
     decode() alias large payloads zero-copy).
@@ -370,7 +375,7 @@ def _recv_exact(sock: socket.socket, n: int,
         progress = ctypes.c_uint64(0)
         while True:
             rc = lib.wc_recv_exact(sock.fileno(), arr, n,
-                                   ctypes.byref(progress))
+                                   ctypes.byref(progress), stages)
             if rc != -_errno.EINTR:
                 break
         if rc == _native.PEER_CLOSED:
@@ -407,8 +412,27 @@ def _recv_frame(sock, crc: bool = False,
         return sock.recv_frame()
     header = _recv_exact(sock, _FRAME_HDR.size)
     kind, tag, length = _FRAME_HDR.unpack(header)
-    payload = (_recv_exact(sock, length, midframe=True) if length
-               else bytearray())
+    if length:
+        # Native stage scratch for the payload read (the header read is
+        # idle-reader wait, not transfer): the resulting
+        # ``wire.recv.syscall`` span lands on this reader thread's lane
+        # as the recv-side counterpart of ``wire.write.syscall``.
+        stages = None
+        t0 = 0
+        if trace.enabled():
+            import ctypes as _ctypes
+
+            stages = (_ctypes.c_uint64 * 3)()
+            t0 = time.perf_counter_ns()
+        payload = _recv_exact(sock, length, midframe=True, stages=stages)
+        if stages is not None and stages[1]:
+            trace.add_span("wire.recv.syscall", t0 / 1e3, stages[0] / 1e3,
+                           source=src, tag=tag, bytes=int(stages[2]),
+                           recv_calls=int(stages[1]))
+            trace.count("wire.native.rx.syscall_ns", int(stages[0]))
+            trace.count("wire.native.rx.recv_calls", int(stages[1]))
+    else:
+        payload = bytearray()
     if crc and kind == KIND_DATA:
         trailer = _recv_exact(sock, _CRC_TRAILER.size, midframe=True)
         if trace.enabled():
@@ -616,11 +640,34 @@ class TcpNetwork:
         try:
             try:
                 if tracing:
+                    # Native stage scratch: when _send_frame takes the
+                    # wirecore path it accumulates per-stage ns here,
+                    # which become child spans under wire.write — the
+                    # named microseconds the transport rewrite needs
+                    # (docs/PERF_NOTES.md).
+                    import ctypes as _ctypes
+
+                    stages = (_ctypes.c_uint64 * 4)()
                     with trace.span("wire.write", dest=dest, tag=tag,
                                     bytes=nbytes, crc=peer.dial_crc):
+                        t0w = time.perf_counter_ns()
                         _send_frame(peer.dial_sock, peer.dial_lock,
                                     KIND_DATA, tag, prefix, view,
-                                    crc=peer.dial_crc, fault=fault)
+                                    crc=peer.dial_crc, fault=fault,
+                                    stages=stages)
+                    if stages[2]:
+                        asm_us = stages[0] / 1e3
+                        trace.add_span("wire.write.assemble", t0w / 1e3,
+                                       asm_us, dest=dest, tag=tag)
+                        trace.add_span("wire.write.syscall",
+                                       t0w / 1e3 + asm_us,
+                                       stages[1] / 1e3, dest=dest,
+                                       tag=tag, bytes=int(stages[3]),
+                                       writev_calls=int(stages[2]))
+                        trace.count("wire.native.tx.syscall_ns",
+                                    int(stages[1]))
+                        trace.count("wire.native.tx.writev_calls",
+                                    int(stages[2]))
                 else:
                     _send_frame(peer.dial_sock, peer.dial_lock, KIND_DATA,
                                 tag, prefix, view, crc=peer.dial_crc,
@@ -649,15 +696,26 @@ class TcpNetwork:
 
         With ``--mpi-optimeout`` the payload wait is bounded: a sender
         that never arrives (peer wedged or dead without a detectable
-        connection loss) raises :class:`DeadlineError`."""
+        connection loss) raises :class:`DeadlineError`. The deadline
+        also covers the decode phase: decode is uninterruptible
+        Python/numpy work, so it runs to completion, but if the
+        operation as a whole then exceeds the deadline the receive
+        raises :class:`DeadlineError` instead of returning late data
+        (docs/FAULT_TOLERANCE.md)."""
         self._check_rank(source)
         if self._chaos is not None:
             self._chaos.on_op("receive", source, tag)
+        # Op-elapsed origin for the decode-phase deadline check. Taken
+        # AFTER the chaos hook: injected pre-op latency has always been
+        # outside the deadline and must stay there.
+        t0_op = time.monotonic() if self.optimeout is not None else 0.0
         if source == self._rank:
             payload = self._local.receive(
                 tag, timeout=self.optimeout,
                 op=f"receive(source={source}, tag={tag}) self rendezvous")
-            return codec_decode(payload, out=out)
+            data = codec_decode(payload, out=out)
+            self._check_decode_deadline(t0_op, source, tag)
+            return data
         peer = self._peers[source]
         slot, gen = peer.receivetags.claim(tag)
         tracing = trace.enabled()
@@ -690,8 +748,29 @@ class TcpNetwork:
                         len(payload))
             with trace.span("wire.decode", source=source, tag=tag,
                             bytes=len(payload)):
-                return codec_decode(payload, out=out)
-        return codec_decode(payload, out=out)
+                data = codec_decode(payload, out=out)
+        else:
+            data = codec_decode(payload, out=out)
+        self._check_decode_deadline(t0_op, source, tag)
+        return data
+
+    def _check_decode_deadline(self, t0_op: float, source: int,
+                               tag: int) -> None:
+        """Deadline coverage for the decode phase: a giant payload
+        whose decode outlives ``--mpi-optimeout`` used to complete
+        anyway (the known gap in docs/FAULT_TOLERANCE.md). The decode
+        itself cannot be interrupted mid-way, so the check runs at its
+        completion — the op fails with the same typed error the wait
+        phases raise, rather than silently returning after the
+        deadline. The ack has already been written by this point, so
+        the sender correctly sees its rendezvous complete; deadline
+        semantics have always been indeterminate-at-the-boundary
+        (docs/FAULT_TOLERANCE.md §--mpi-optimeout)."""
+        if self.optimeout is not None and \
+                time.monotonic() - t0_op > self.optimeout:
+            raise DeadlineError(
+                f"receive(source={source}, tag={tag}) decode",
+                self.optimeout)
 
     def notify_abort(self, code: int) -> None:
         """Failure propagation for ``api.abort()``: best-effort ABORT

@@ -220,6 +220,16 @@ class ChaosEngine:
                           f"written to {path}", file=_sys.stderr)
             except BaseException:  # noqa: BLE001 - dying anyway
                 pass
+            # Under --mpi-trace-stream, push the tracer's unflushed
+            # tail to the spool so the merged trace / job postmortem
+            # can show this rank's spans right up to the injected
+            # death.
+            try:
+                from .utils import trace as _trace
+
+                _trace.flush_stream()
+            except BaseException:  # noqa: BLE001 - dying anyway
+                pass
             _sys.stderr.flush()
             os._exit(CRASH_EXIT_CODE)
         if "latency" in cfg.modes and \

@@ -48,6 +48,7 @@ __all__ = [
     "FLAG_TRACE_OUT",
     "FLAG_METRICS_OUT",
     "FLAG_POSTMORTEM",
+    "FLAG_TRACE_STREAM",
     "DEFAULT_PROTOCOL",
     "DEFAULT_INIT_TIMEOUT",
 ]
@@ -69,6 +70,9 @@ FLAG_CHAOS = "mpi-chaos"
 FLAG_TRACE_OUT = "mpi-trace-out"
 FLAG_METRICS_OUT = "mpi-metrics-out"
 FLAG_POSTMORTEM = "mpi-postmortem"
+# Streaming trace spool directory: ranks flush bounded span chunks there
+# continuously, making traces crash-durable (docs/OBSERVABILITY.md).
+FLAG_TRACE_STREAM = "mpi-trace-stream"
 
 ENV_PREFIX = "MPI_TPU_"
 ENV_ADDR = ENV_PREFIX + "ADDR"
@@ -82,6 +86,7 @@ ENV_CHAOS = ENV_PREFIX + "CHAOS"
 ENV_TRACE_OUT = ENV_PREFIX + "TRACE_OUT"
 ENV_METRICS_OUT = ENV_PREFIX + "METRICS_OUT"
 ENV_POSTMORTEM = ENV_PREFIX + "POSTMORTEM_DIR"
+ENV_TRACE_STREAM = ENV_PREFIX + "TRACE_STREAM"
 
 DEFAULT_PROTOCOL = "tcp"  # flags.go:48 default
 # The reference's DurationFlag has no default (zero value); Network.Init then
@@ -164,6 +169,7 @@ class MpiFlags:
     trace_out: Optional[str] = None    # merged chrome-trace sink (rank 0)
     metrics_out: Optional[str] = None  # per-rank metrics JSON artifact
     postmortem: Optional[str] = None   # flight-recorder dump directory
+    trace_stream: Optional[str] = None  # streaming trace spool directory
 
     def as_argv(self) -> List[str]:
         """Render back to launcher-injectable argv (gompirun.go:77 ABI)."""
@@ -190,12 +196,15 @@ class MpiFlags:
             out += [f"--{FLAG_METRICS_OUT}", self.metrics_out]
         if self.postmortem is not None:
             out += [f"--{FLAG_POSTMORTEM}", self.postmortem]
+        if self.trace_stream is not None:
+            out += [f"--{FLAG_TRACE_STREAM}", self.trace_stream]
         return out
 
 
 _FLAG_NAMES = {FLAG_ADDR, FLAG_ALLADDR, FLAG_INITTIMEOUT, FLAG_PROTOCOL,
                FLAG_PASSWORD, FLAG_OPTIMEOUT, FLAG_CRC, FLAG_CHAOS,
-               FLAG_TRACE_OUT, FLAG_METRICS_OUT, FLAG_POSTMORTEM}
+               FLAG_TRACE_OUT, FLAG_METRICS_OUT, FLAG_POSTMORTEM,
+               FLAG_TRACE_STREAM}
 
 # Overridable argv source for tests (instead of mutating sys.argv).
 _argv_override: Optional[Sequence[str]] = None
@@ -301,6 +310,10 @@ def parse_flags(argv: Optional[Sequence[str]] = None,
     postmortem = raw.get(FLAG_POSTMORTEM, env.get(ENV_POSTMORTEM))
     if postmortem:
         flags.postmortem = postmortem
+
+    trace_stream = raw.get(FLAG_TRACE_STREAM, env.get(ENV_TRACE_STREAM))
+    if trace_stream:
+        flags.trace_stream = trace_stream
 
     return flags
 

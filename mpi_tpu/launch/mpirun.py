@@ -32,7 +32,7 @@ from typing import List, Optional, Sequence
 from ..flags import (FLAG_ADDR, FLAG_ALLADDR, FLAG_CHAOS, FLAG_CRC,
                      FLAG_INITTIMEOUT, FLAG_METRICS_OUT, FLAG_OPTIMEOUT,
                      FLAG_PASSWORD, FLAG_POSTMORTEM, FLAG_TRACE_OUT,
-                     format_duration)
+                     FLAG_TRACE_STREAM, format_duration)
 
 DEFAULT_PORT_BASE = 6000  # gompirun.go:46
 # Seconds between SIGTERM and SIGKILL when reaping survivors of a failed
@@ -51,7 +51,8 @@ def build_commands(nprocs: int, prog: str, prog_args: Sequence[str],
                    chaos: Optional[str] = None,
                    trace_out: Optional[str] = None,
                    metrics_out: Optional[str] = None,
-                   postmortem_dir: Optional[str] = None) -> List[List[str]]:
+                   postmortem_dir: Optional[str] = None,
+                   trace_stream: Optional[str] = None) -> List[List[str]]:
     """Synthesize the per-rank command lines (the launcher<->program ABI).
 
     Pure function so tests can check the protocol without spawning."""
@@ -81,6 +82,8 @@ def build_commands(nprocs: int, prog: str, prog_args: Sequence[str],
             cmd += [f"--{FLAG_METRICS_OUT}", metrics_out]
         if postmortem_dir is not None:
             cmd += [f"--{FLAG_POSTMORTEM}", postmortem_dir]
+        if trace_stream is not None:
+            cmd += [f"--{FLAG_TRACE_STREAM}", trace_stream]
         cmds.append(cmd)
     return cmds
 
@@ -96,7 +99,8 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
            chaos: Optional[str] = None,
            trace_out: Optional[str] = None,
            metrics_out: Optional[str] = None,
-           postmortem_dir: Optional[str] = None) -> int:
+           postmortem_dir: Optional[str] = None,
+           trace_stream: Optional[str] = None) -> int:
     """Spawn all ranks concurrently, wait for all (gompirun.go:57-93).
 
     Returns the first non-zero child exit code, else 0. When any rank
@@ -112,7 +116,12 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
     injects the flight-recorder dump directory, and after a failed job
     the survivors' and victims' dumps are folded into
     ``<dir>/job_postmortem.json`` with the dead rank's last in-flight
-    operation echoed to stderr."""
+    operation echoed to stderr. ``trace_stream`` injects the streaming
+    spool directory (``--mpi-trace-stream``): ranks flush span chunks
+    there continuously, and after a failed job the launcher folds each
+    dead rank's last spooled spans into the job postmortem and — when
+    ``trace_out`` is also set but the merged trace never got written —
+    reconstructs a merged chrome trace from the spools alone."""
     if postmortem_dir is None:
         # A user-set env dir wins over inventing a temp dir (the
         # injected argv flag would otherwise shadow the env in the
@@ -127,11 +136,18 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
         postmortem_dir = tempfile.mkdtemp(prefix="mpi-postmortem-")
         print(f"mpirun: chaos active — flight-recorder postmortems in "
               f"{postmortem_dir}", file=sys.stderr)
+    if trace_stream is not None:
+        try:
+            os.makedirs(trace_stream, exist_ok=True)
+        except OSError as exc:
+            print(f"mpirun: cannot create trace-stream dir "
+                  f"{trace_stream}: {exc}", file=sys.stderr)
     cmds = build_commands(nprocs, prog, prog_args, port_base=port_base,
                           timeout=timeout, password=password,
                           optimeout=optimeout, crc=crc, chaos=chaos,
                           trace_out=trace_out, metrics_out=metrics_out,
-                          postmortem_dir=postmortem_dir)
+                          postmortem_dir=postmortem_dir,
+                          trace_stream=trace_stream)
     procs: List[subprocess.Popen] = []
     child_env = dict(os.environ if env is None else env)
     # Children run with the PROGRAM's cwd on their sys.path, not this
@@ -144,9 +160,9 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
     if pkg_root not in existing.split(os.pathsep):
         child_env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
                                    if existing else pkg_root)
-    if trace_out is not None:
+    if trace_out is not None or trace_stream is not None:
         # Span recording must be live in every rank for the merged
-        # trace to have content; the flag names only the sink.
+        # trace / spool to have content; the flags name only the sinks.
         child_env.setdefault("MPI_TPU_TRACE", "1")
     for i, cmd in enumerate(cmds):
         # stdio passthrough, as gompirun pipes child output (gompirun.go:86-88)
@@ -188,6 +204,14 @@ def launch(nprocs: int, prog: str, prog_args: Sequence[str],
             time.sleep(0.05)
     if first_bad and postmortem_dir:
         _collect_job_postmortem(postmortem_dir)
+    if first_bad and trace_stream is not None:
+        # Crash-durable observability: whatever the dead ranks flushed
+        # is on disk even though they never reached the Finalize
+        # gather (and even if the flight-recorder dump never ran).
+        _fold_spools_into_postmortem(trace_stream,
+                                     postmortem_dir or trace_stream)
+        if trace_out is not None:
+            _reconstruct_trace_from_spools(trace_stream, trace_out)
     if auto_pm_dir:
         # Don't leak an auto-created temp dir: a clean chaos run (or a
         # failure that produced no dumps) leaves it empty — remove it.
@@ -255,6 +279,104 @@ def _collect_job_postmortem(pm_dir: str) -> Optional[str]:
     return out
 
 
+def _fold_spools_into_postmortem(spool_dir: str,
+                                 report_dir: str) -> Optional[str]:
+    """Attach each rank's last spooled spans to ``job_postmortem.json``.
+    A SIGKILL'd or hung rank never runs its flight-recorder dump, but
+    its trace spool survives on disk — so the job report can still say
+    what the rank was doing, from its last flushed spans. Creates the
+    report if the flight-dump pass produced none."""
+    import json
+
+    from ..observe import stream
+
+    bundles = stream.scan_spools(spool_dir)
+    if not bundles:
+        return None
+    out = os.path.join(report_dir, "job_postmortem.json")
+    report = {"version": 1, "ranks": {}}
+    try:
+        with open(out) as f:
+            report = json.load(f)
+    except (OSError, ValueError):
+        pass
+    tails = {}
+    for r in sorted(bundles):
+        b = bundles[r]
+        spans = b.get("events", [])
+        last = spans[-8:]
+        tails[str(r)] = {
+            "spool": b.get("spool"),
+            "events_spooled": len(spans),
+            "chunks": b.get("spool_chunks", 0),
+            "last_spans": [{"name": e.get("name"),
+                            "ts_us": e.get("ts_us"),
+                            "dur_us": e.get("dur_us")} for e in last],
+        }
+        if last and str(r) not in report.get("ranks", {}):
+            # No flight dump for this rank — the spool is the only
+            # record of its final moments; echo the last span.
+            print(f"mpirun: rank {r}: no flight dump; last spooled "
+                  f"span: {last[-1].get('name', '?')} "
+                  f"({len(spans)} spans in spool)", file=sys.stderr)
+    report["spool_tails"] = tails
+    try:
+        os.makedirs(report_dir, exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(report, f, indent=1)
+    except OSError as exc:
+        print(f"mpirun: cannot write job postmortem: {exc}",
+              file=sys.stderr)
+        return None
+    print(f"mpirun: spool tails folded into {out}", file=sys.stderr)
+    return out
+
+
+def _reconstruct_trace_from_spools(spool_dir: str,
+                                   trace_out: str) -> Optional[str]:
+    """Rebuild the merged chrome trace from spool files alone when the
+    Finalize-time gather never completed (rank 0 itself died, or the
+    job aborted before finalize). A spool holds everything its rank
+    flushed — for survivors that includes the finalize-time tail — so
+    the reconstruction is a faithful merged trace, clock-aligned by the
+    per-chunk wall anchors (same-machine launch: zero offsets)."""
+    import json
+
+    from ..observe import collect, stream
+
+    bundles = stream.scan_spools(spool_dir)
+    if not bundles:
+        return None
+    existing = None
+    try:
+        with open(trace_out) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        existing = None
+    if existing is not None:
+        merged = set(existing.get("metadata", {}).get("ranks", []))
+        if set(bundles) <= merged:
+            return None  # the live gather already covered every spool
+    offsets = {r: {"offset_ns": 0.0, "rtt_ns": 0.0} for r in bundles}
+    doc = collect.merge_bundles(bundles, offsets)
+    doc["metadata"]["source"] = "spool-reconstruction"
+    doc["metadata"]["spool_dir"] = spool_dir
+    try:
+        d = os.path.dirname(trace_out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(trace_out, "w") as f:
+            json.dump(doc, f)
+    except OSError as exc:
+        print(f"mpirun: cannot write reconstructed trace: {exc}",
+              file=sys.stderr)
+        return None
+    print(f"mpirun: merged trace reconstructed from spools in "
+          f"{spool_dir} -> {trace_out} (ranks {sorted(bundles)})",
+          file=sys.stderr)
+    return trace_out
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="mpirun",
@@ -290,6 +412,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "as --mpi-postmortem; defaults to a temp "
                              "dir when --chaos is active; failed jobs "
                              "get a collected job_postmortem.json)")
+    parser.add_argument("--trace-stream", default=None,
+                        help="streaming trace spool directory (injected "
+                             "as --mpi-trace-stream; enables "
+                             "MPI_TPU_TRACE=1; ranks flush span chunks "
+                             "continuously so a failed job still yields "
+                             "a merged trace / postmortem from the "
+                             "spools)")
     parser.add_argument("--kill-grace", type=float,
                         default=DEFAULT_KILL_GRACE,
                         help="seconds between SIGTERM and SIGKILL when "
@@ -308,7 +437,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   optimeout=args.optimeout, crc=args.crc,
                   chaos=args.chaos, trace_out=args.trace_out,
                   metrics_out=args.metrics_out,
-                  postmortem_dir=args.postmortem_dir)
+                  postmortem_dir=args.postmortem_dir,
+                  trace_stream=args.trace_stream)
 
 
 if __name__ == "__main__":

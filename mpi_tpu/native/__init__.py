@@ -45,22 +45,26 @@ def _cache_dir() -> str:
 
 
 def _configure_wirecore(lib: ctypes.CDLL) -> None:
+    # v4: every entry point grew a trailing nullable uint64_t *stages
+    # scratch — per-call stage nanoseconds/counts for the tracer's
+    # wire.* child spans (pass None on the untraced hot path).
+    stages_t = ctypes.POINTER(ctypes.c_uint64)
     lib.wc_send_frame.restype = ctypes.c_int
     lib.wc_send_frame.argtypes = [
         ctypes.c_int, ctypes.c_uint8, ctypes.c_int64,
-        ctypes.c_char_p, ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint64)]
+        ctypes.c_char_p, ctypes.c_uint32, stages_t, stages_t]
     lib.wc_send_frame2.restype = ctypes.c_int
     lib.wc_send_frame2.argtypes = [
         ctypes.c_int, ctypes.c_uint8, ctypes.c_int64,
         ctypes.c_char_p, ctypes.c_uint32,
         ctypes.c_void_p, ctypes.c_uint32,
-        ctypes.POINTER(ctypes.c_uint64)]
+        stages_t, stages_t]
     lib.wc_recv_exact.restype = ctypes.c_int
     lib.wc_recv_exact.argtypes = [
         ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64,
-        ctypes.POINTER(ctypes.c_uint64)]
+        stages_t, stages_t]
     lib.wc_version.restype = ctypes.c_int
-    if lib.wc_version() != 3:
+    if lib.wc_version() != 4:
         raise RuntimeError("wirecore version mismatch")
 
 

@@ -15,6 +15,13 @@ Three pillars on top of the process-local tracer
     typed errors and chaos crashes (``--mpi-postmortem`` /
     ``MPI_TPU_POSTMORTEM_DIR``); ``mpirun`` folds survivors' dumps into
     one job report;
+  * **streaming trace spooling** (:mod:`.stream`) — with
+    ``--mpi-trace-stream DIR`` (``MPI_TPU_TRACE_STREAM``) each rank
+    flushes bounded span chunks to a per-rank spool file continuously,
+    keeping tracer memory O(chunk) and making everything already
+    flushed crash-durable: the Finalize gather reads spools back, rank
+    0 reconstructs dead ranks' tracks from their spool files, and
+    ``mpirun`` can rebuild a merged trace from spools alone;
   * **live metrics + straggler detection** (:mod:`.metrics`) —
     per-collective arrival skew, an ``observe top`` text summary on
     SIGUSR1 or at Finalize (``MPI_TPU_OBSERVE_SUMMARY=1``), and a
@@ -39,7 +46,8 @@ from . import flight, metrics  # noqa: F401 - re-exported submodules
 
 __all__ = ["flight", "metrics", "on_init", "on_finalize",
            "postmortem_dir", "trace_out_path", "metrics_out_path",
-           "summary_enabled", "fatal_error_hook", "reset_for_testing"]
+           "trace_stream_dir", "summary_enabled", "fatal_error_hook",
+           "reset_for_testing"]
 
 # Fatal typed failures that trigger a flight-recorder postmortem (by
 # class name: the backends that define them import lazily, and a name
@@ -52,6 +60,7 @@ _cfg_lock = threading.Lock()
 _cfg: Optional[dict] = None
 _collected: Set[Tuple[int, int]] = set()
 _metrics_written: Set[Tuple[int, int]] = set()
+_spooler: Optional[Any] = None
 
 
 def _flag_or_env(flag: str, env: str) -> Optional[str]:
@@ -76,6 +85,8 @@ def _config() -> dict:
                                             flagmod.ENV_METRICS_OUT),
                 "postmortem": _flag_or_env(flagmod.FLAG_POSTMORTEM,
                                            flagmod.ENV_POSTMORTEM),
+                "trace_stream": _flag_or_env(flagmod.FLAG_TRACE_STREAM,
+                                             flagmod.ENV_TRACE_STREAM),
             }
         return _cfg
 
@@ -90,6 +101,10 @@ def trace_out_path() -> Optional[str]:
 
 def metrics_out_path() -> Optional[str]:
     return _config()["metrics_out"]
+
+
+def trace_stream_dir() -> Optional[str]:
+    return _config()["trace_stream"]
 
 
 def summary_enabled() -> bool:
@@ -108,11 +123,36 @@ def on_init(impl: Any) -> None:
     try:
         from ..utils import trace
 
-        if trace_out_path() and not trace.enabled():
+        if (trace_out_path() or trace_stream_dir()) and not trace.enabled():
             trace.enable()
+        _install_spooler(impl)
         metrics.install_sigusr1(rank_fn=impl.rank)
     except Exception:  # noqa: BLE001
         pass
+
+
+def _install_spooler(impl: Any) -> None:
+    """Start streaming this process's tracer to a per-rank spool file.
+    One spooler per process: under the hybrid driver every local rank
+    thread shares the process tracer, so they share the spool too (the
+    file is labelled with the first rank to init)."""
+    global _spooler
+    directory = trace_stream_dir()
+    if not directory:
+        return
+    from ..utils import trace
+
+    with _cfg_lock:
+        if _spooler is not None:
+            return
+        from . import stream
+
+        _spooler = stream.SpoolWriter(directory)
+    try:
+        _spooler.set_rank(impl.rank())
+    except Exception:  # noqa: BLE001
+        pass
+    trace.set_stream(_spooler)
 
 
 def on_finalize(impl: Any) -> None:
@@ -128,6 +168,18 @@ def on_finalize(impl: Any) -> None:
 
     cfg = _config()
     from ..utils import trace
+
+    if cfg["trace_stream"]:
+        # Push the resident tail out and stamp the footer BEFORE the
+        # gather, so the spool is a complete standalone record and the
+        # gather's spool read-back sees every span.
+        try:
+            trace.flush_stream()
+            st = trace.stream()
+            if st is not None:
+                st.write_footer()
+        except Exception:  # noqa: BLE001
+            pass
 
     if cfg["trace_out"] and trace.enabled():
         with _cfg_lock:
@@ -171,6 +223,14 @@ def fatal_error_hook(exc: BaseException) -> None:
     if type(exc).__name__ not in _FATAL_NAMES:
         return
     try:
+        # Make this rank's last spans durable before anything else: the
+        # process may be about to die without reaching finalize.
+        from ..utils import trace
+
+        trace.flush_stream()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
         path = flight.dump(f"{type(exc).__name__}: {exc}")
         if path:
             print(f"mpi_tpu: observe: flight-recorder postmortem "
@@ -180,10 +240,16 @@ def fatal_error_hook(exc: BaseException) -> None:
 
 
 def reset_for_testing() -> None:
-    global _cfg
+    global _cfg, _spooler
+    from ..utils import trace
+
+    trace.set_stream(None)
     with _cfg_lock:
         _cfg = None
         _collected.clear()
         _metrics_written.clear()
+        if _spooler is not None:
+            _spooler.close()
+            _spooler = None
     flight.reset_for_testing()
     metrics.reset_for_testing()

@@ -26,13 +26,26 @@ timeline, emits per-rank process-name metadata tracks, and computes
 **cross-process straggler skew** from the clock-aligned collective
 entries. Every receive is bounded (default 60 s,
 ``MPI_TPU_OBSERVE_TIMEOUT``) so a crashed rank stalls collection, not
-the job: missing ranks are noted in the merged metadata and skipped.
+the job: missing ranks are noted in the merged metadata and skipped —
+unless streaming spooling (``--mpi-trace-stream``) is active, in which
+case rank 0 reconstructs a dead rank's track from its spool file
+(:mod:`.stream`), so even a SIGKILL'd rank appears in the merged trace
+up to its last flushed chunk.
+
+Hybrid cross-host merge: the hybrid driver's ranks are threads sharing
+one process tracer per host, so the per-rank gather above would ship
+the same buffer N times. Instead one leader thread per host (local
+rank 0) runs the same ping/bundle protocol over the DCN/tcp tier
+(:func:`_gather_hosts`), and host 0 merges one track per host with
+per-host clock alignment — the merged trace carries wire spans from
+every host, not just rank 0's.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -86,8 +99,11 @@ def _bounded(fn: Callable[[], Any], timeout: float, what: str) -> Any:
 
 
 def local_bundle(rank: int) -> Dict[str, Any]:
-    """This rank's contribution to the merged trace."""
-    return {
+    """This rank's contribution to the merged trace. Under streaming
+    spooling the resident buffer holds only the unflushed tail; the
+    already-spooled chunks are read back and prepended so the gathered
+    bundle is complete either way."""
+    bundle = {
         "rank": rank,
         "pid": os.getpid(),
         "anchor_ns": trace.wall_anchor_ns(),
@@ -97,6 +113,17 @@ def local_bundle(rank: int) -> Dict[str, Any]:
         "collective_entries": metrics.collective_entries(),
         "flight": {"op_counts": flight.snapshot()["op_counts"]},
     }
+    st = trace.stream()
+    if st is not None and st.path is not None:
+        try:
+            spooled = st.read_back_events()
+        except Exception:  # noqa: BLE001 - spool is best-effort
+            spooled = []
+        if spooled:
+            bundle["events"] = spooled + bundle["events"]
+        bundle["spool"] = st.path
+        bundle["spool_chunks"] = st.chunks_written
+    return bundle
 
 
 def estimate_offsets(samples: List[Dict[str, float]]) -> Dict[str, float]:
@@ -134,16 +161,21 @@ def _aligned_entries(bundles: Dict[int, Dict[str, Any]],
 
 def merge_bundles(bundles: Dict[int, Dict[str, Any]],
                   offsets: Dict[int, Dict[str, float]],
-                  missing: Optional[List[int]] = None) -> Dict[str, Any]:
+                  missing: Optional[List[int]] = None,
+                  labels: Optional[Dict[int, str]] = None
+                  ) -> Dict[str, Any]:
     """Merge per-rank bundles into one chrome-trace document: pid =
-    rank (one track per rank), timestamps clock-aligned to rank 0."""
+    rank (one track per rank), timestamps clock-aligned to rank 0.
+    ``labels`` overrides a track's process-name metadata (the hybrid
+    cross-host merge labels tracks by host + rank range)."""
     base = None
     events: List[Dict[str, Any]] = []
     for r in sorted(bundles):
         b = bundles[r]
         off = offsets.get(r, {}).get("offset_ns", 0.0)
+        label = (labels or {}).get(r) or f"rank {r} (pid {b['pid']})"
         events.append({"name": "process_name", "ph": "M", "pid": r,
-                       "args": {"name": f"rank {r} (pid {b['pid']})"}})
+                       "args": {"name": label}})
         for e in b["events"]:
             abs_us = e["ts_us"] + (b["anchor_ns"] - off) / 1e3
             if base is None or abs_us < base:
@@ -199,6 +231,25 @@ def collect_and_merge(impl: Any, out_path: str) -> Optional[str]:
     rank, size = impl.rank(), impl.size()
     timeout = _timeout()
     if size == 1 or getattr(impl, "SHARED_PROCESS_TRACER", False):
+        # Hybrid: ranks are threads per host, but hosts are separate
+        # processes linked by the tcp tier — gather per HOST over it so
+        # the merged trace carries every host's buffer, not just rank
+        # 0's (tentpole 3). Degrades to the single-host document when
+        # the driver has no multi-host tcp tier (xla) or the cross-host
+        # gather fails.
+        tcp = getattr(impl, "_tcp", None)
+        try:
+            nhosts = tcp.size() if tcp is not None else 1
+        except Exception:  # noqa: BLE001
+            nhosts = 1
+        if nhosts > 1:
+            try:
+                return _gather_hosts(impl, tcp, nhosts, size, timeout,
+                                     out_path)
+            except Exception as exc:  # noqa: BLE001
+                print(f"mpi_tpu: observe: cross-host trace merge failed "
+                      f"({exc}); falling back to rank 0's host",
+                      file=sys.stderr)
         if rank != 0:
             return None
         doc = merge_bundles({0: local_bundle(0)},
@@ -274,14 +325,174 @@ def _gather(impl: Any, rank: int, size: int, timeout: float,
                            "bundle")
             bundles[src] = json.loads(bytes(raw).decode("utf-8"))
         except Exception as exc:  # noqa: BLE001 - skip dead ranks
-            import sys as _sys
-
             print(f"mpi_tpu: observe: skipping rank {src} in trace "
-                  f"collection: {exc}", file=_sys.stderr)
+                  f"collection: {exc}", file=sys.stderr)
             missing.append(src)
+    recovered = _recover_from_spools(bundles, offsets, missing)
     doc = merge_bundles(bundles, offsets, missing=missing)
+    if recovered:
+        doc["metadata"]["spool_reconstructed_ranks"] = sorted(recovered)
     _write(out_path, doc)
     return out_path
+
+
+def _recover_from_spools(bundles: Dict[int, Dict[str, Any]],
+                         offsets: Dict[int, Dict[str, float]],
+                         missing: List[int]) -> List[int]:
+    """Rebuild dead ranks' tracks from their spool files. A rank that
+    died (SIGKILL, chaos crash, hang) never answered the gather, but
+    under ``--mpi-trace-stream`` everything it flushed survives on
+    disk; fold it back in so the merged trace shows what the dead rank
+    was doing. The rank stays in ``missing_ranks`` — it IS dead — and
+    is additionally listed in ``spool_reconstructed_ranks``."""
+    if not missing:
+        return []
+    recovered: List[int] = []
+    try:
+        from .. import observe as _observe
+        from . import stream as _stream
+
+        spool_dir = _observe.trace_stream_dir()
+        if not spool_dir:
+            return []
+        found = _stream.scan_spools(spool_dir)
+        for src in missing:
+            b = found.get(src)
+            if b is None:
+                continue
+            bundles[src] = b
+            # Same-machine launch (mpirun): spool anchors share rank
+            # 0's wall clock, so a zero offset is the right estimate.
+            offsets.setdefault(src, {"offset_ns": 0.0, "rtt_ns": 0.0})
+            recovered.append(src)
+        if recovered:
+            print(f"mpi_tpu: observe: reconstructed rank(s) "
+                  f"{sorted(recovered)} from trace spool(s) in "
+                  f"{spool_dir}", file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001 - recovery is best-effort
+        print(f"mpi_tpu: observe: spool reconstruction failed: {exc}",
+              file=sys.stderr)
+    return recovered
+
+
+def _gather_hosts(impl: Any, tcp: Any, nhosts: int, size: int,
+                  timeout: float, out_path: str) -> Optional[str]:
+    """Hybrid cross-host merge. One leader thread per host — the thread
+    whose local rank is 0 — ships the host's shared process tracer
+    buffer to host 0 over the DCN/tcp tier with the same ping/pong
+    clock-offset exchange as the per-rank gather; every other rank
+    thread returns immediately. Host 0 merges one chrome-trace track
+    per host (pid = the host's first global rank), labelled with the
+    host index and its global-rank range."""
+    local = impl._local()
+    my_host = tcp.rank()
+    host_offsets = list(impl._offsets)
+    host_counts = list(impl._counts)
+    if local != 0:
+        return None
+
+    def host_bundle() -> Dict[str, Any]:
+        b = local_bundle(host_offsets[my_host])
+        b["host"] = my_host
+        b["ranks"] = list(range(
+            host_offsets[my_host],
+            host_offsets[my_host] + host_counts[my_host]))
+        return b
+
+    # The tcp tier's per-op deadline must not preempt the gather's own
+    # bounded waits (same reasoning as collect_and_merge's suspension,
+    # which does not reach this inner network).
+    saved_optimeout = getattr(tcp, "optimeout", None)
+    if hasattr(tcp, "optimeout"):
+        tcp.optimeout = None
+    try:
+        if my_host != 0:
+            first_wait = timeout * max(1, nhosts - 1)
+            _bounded(lambda: tcp.receive(0, _T_PING), first_wait,
+                     "host ping wait")
+            _bounded(lambda: tcp.send(
+                str(time.time_ns()).encode("ascii"), 0, _T_PONG),
+                timeout, "host pong send")
+            for _ in range(_PINGS - 1):
+                _bounded(lambda: tcp.receive(0, _T_PING), timeout,
+                         "host ping wait")
+                _bounded(lambda: tcp.send(
+                    str(time.time_ns()).encode("ascii"), 0, _T_PONG),
+                    timeout, "host pong send")
+            payload = json.dumps(host_bundle()).encode("utf-8")
+            _bounded(lambda: tcp.send(payload, 0, _T_BUNDLE), timeout,
+                     "host bundle send")
+            return None
+
+        host_bundles: Dict[int, Dict[str, Any]] = {0: host_bundle()}
+        host_clock: Dict[int, Dict[str, float]] = {
+            0: {"offset_ns": 0.0, "rtt_ns": 0.0}}
+        missing_hosts: List[int] = []
+        shared_hosts: List[int] = []
+        for h in range(1, nhosts):
+            try:
+                samples = []
+                for _ in range(_PINGS):
+                    t0 = time.time_ns()
+                    _bounded(lambda: tcp.send(b"", h, _T_PING), timeout,
+                             "host ping send")
+                    peer_ns = int(bytes(_bounded(
+                        lambda: tcp.receive(h, _T_PONG), timeout,
+                        "host pong")).decode("ascii"))
+                    t1 = time.time_ns()
+                    samples.append({"t0_ns": t0, "t1_ns": t1,
+                                    "peer_ns": peer_ns})
+                raw = _bounded(lambda: tcp.receive(h, _T_BUNDLE), timeout,
+                               "host bundle")
+                b = json.loads(bytes(raw).decode("utf-8"))
+                if b.get("pid") == os.getpid():
+                    # Multi-host-in-one-process worlds (tests, bench)
+                    # share ONE tracer: this "remote" host's buffer is
+                    # the same buffer host 0 already contributed, so
+                    # keeping it would duplicate every span. Its spans
+                    # are present via host 0's track.
+                    shared_hosts.append(h)
+                    continue
+                host_bundles[h] = b
+                host_clock[h] = estimate_offsets(samples)
+            except Exception as exc:  # noqa: BLE001 - skip dead hosts
+                print(f"mpi_tpu: observe: skipping host {h} in "
+                      f"cross-host trace merge: {exc}", file=sys.stderr)
+                missing_hosts.append(h)
+
+        # Track key = the host's first global rank (so tracks sort in
+        # rank order in viewers); tid lanes inside a track remain the
+        # per-rank thread names.
+        bundles = {host_offsets[h]: b for h, b in host_bundles.items()}
+        offsets = {host_offsets[h]: o for h, o in host_clock.items()}
+        labels = {
+            host_offsets[h]: (
+                f"host {h} ranks {host_offsets[h]}.."
+                f"{host_offsets[h] + host_counts[h] - 1} "
+                f"(pid {b['pid']})")
+            for h, b in host_bundles.items()}
+        missing_ranks = [r for h in missing_hosts
+                         for r in range(host_offsets[h],
+                                        host_offsets[h] + host_counts[h])]
+        doc = merge_bundles(bundles, offsets, missing=missing_ranks,
+                            labels=labels)
+        doc["metadata"].update({
+            "shared_process_tracer": True,
+            "ranks": list(range(size)),
+            "hosts": nhosts,
+            "hosts_merged": sorted(host_bundles),
+            "hosts_missing": sorted(missing_hosts),
+            "hosts_in_gatherer_process": sorted(shared_hosts),
+            "ranks_by_host": {
+                str(h): list(range(host_offsets[h],
+                                   host_offsets[h] + host_counts[h]))
+                for h in range(nhosts)},
+        })
+        _write(out_path, doc)
+        return out_path
+    finally:
+        if hasattr(tcp, "optimeout"):
+            tcp.optimeout = saved_optimeout
 
 
 def _write(path: str, doc: Dict[str, Any]) -> None:

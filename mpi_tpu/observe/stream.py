@@ -1,0 +1,272 @@
+"""Streaming trace spooling — crash-durable per-rank chunk files.
+
+The Finalize-batched trace collection (:mod:`.collect`) has two
+structural weaknesses: a rank holds its whole span buffer in memory
+(O(job) growth, capped only by dropping), and a rank that dies by
+SIGKILL / chaos ``crash@K`` / hang takes its evidence with it. This
+module makes the tracer *continuous*: with ``--mpi-trace-stream DIR``
+(``MPI_TPU_TRACE_STREAM``) each rank's tracer flushes bounded chunks to
+an append-only per-rank spool file, so resident buffer memory stays
+O(chunk) and everything already flushed survives any death the OS
+survives (the file's written bytes are kernel-owned after ``flush()``;
+only the unflushed tail — at most one chunk — dies with the process).
+
+Spool chunk format (newline-delimited JSON, one object per line, each
+line self-describing so a reader needs no header):
+
+    {"v": 1, "t": "chunk", "rank": R, "pid": P, "seq": N,
+     "anchor_ns": A, "events": [span...]}          # flushed span batch
+    {"v": 1, "t": "footer", "rank": R, "pid": P, "counters": {...},
+     "dropped": D, "collective_entries": [...],
+     "op_counts": {...}}                           # once, at finalize
+
+``seq`` is the chunk sequence number (gaps reveal lost writes);
+``anchor_ns`` is the tracer's perf_counter→wall-clock anchor, repeated
+per chunk so any single surviving line places its spans on the wall
+clock. A truncated final line (death mid-write) is skipped by the
+reader; everything before it parses.
+
+Consumers: :func:`mpi_tpu.observe.collect.local_bundle` reads a rank's
+own spool back so the Finalize gather still produces a complete merged
+trace; rank 0's gather and ``mpirun`` reconstruct *dead* ranks' bundles
+from their spool files (:func:`scan_spools` / :func:`parse_spool`),
+folding pre-crash spans into the merged chrome trace and
+``job_postmortem.json`` even when the flight-recorder dump never ran.
+
+Flush watermarks: size (``MPI_TPU_TRACE_STREAM_EVENTS``, default 512
+events) or age (``MPI_TPU_TRACE_STREAM_AGE_S``, default 1.0 s, checked
+when the next event arrives — a fully idle rank keeps its sub-chunk
+tail buffered, which is fine: an idle rank has nothing new to lose).
+Spooling I/O failures are recorded and silence the writer — streaming
+observability must never take the job down.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SpoolWriter", "spool_path", "parse_spool", "scan_spools",
+           "reconstruct_bundles", "SPOOL_VERSION"]
+
+SPOOL_VERSION = 1
+
+_DEFAULT_CHUNK_EVENTS = 512
+_DEFAULT_MAX_AGE_S = 1.0
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return max(0.0, float(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def spool_path(directory: str, rank: Any, pid: int) -> str:
+    return os.path.join(directory, f"spool-rank{rank}-pid{pid}.ndjson")
+
+
+class SpoolWriter:
+    """Per-process spool sink, installed into the tracer with
+    :func:`mpi_tpu.utils.trace.set_stream`. The tracer calls
+    :meth:`write_chunk` under its own lock whenever the resident buffer
+    hits a watermark (it reads ``max_events`` / ``max_age_s`` /
+    ``first_t`` directly — the watermark state lives here so the
+    tracer's disabled path stays a single attribute check)."""
+
+    def __init__(self, directory: str, rank: Optional[int] = None):
+        self.directory = directory
+        self.rank = rank
+        self.max_events = _env_int("MPI_TPU_TRACE_STREAM_EVENTS",
+                                   _DEFAULT_CHUNK_EVENTS)
+        self.max_age_s = _env_float("MPI_TPU_TRACE_STREAM_AGE_S",
+                                    _DEFAULT_MAX_AGE_S)
+        # Monotonic time of the oldest unflushed event (None = empty
+        # buffer); maintained by the tracer's add_event, reset here.
+        self.first_t: Optional[float] = None
+        self.path: Optional[str] = None
+        self.seq = 0
+        self.chunks_written = 0
+        self.events_written = 0
+        self.broken: Optional[str] = None
+        self.footer_written = False
+        self._f = None
+        self._io_lock = threading.Lock()
+
+    def set_rank(self, rank: int) -> None:
+        """Bind the rank once known (init order: the spooler can be
+        installed before the backend has assigned ranks)."""
+        if self.path is None:
+            self.rank = rank
+
+    def _open(self):
+        if self._f is None and self.broken is None:
+            try:
+                os.makedirs(self.directory, exist_ok=True)
+                self.path = spool_path(
+                    self.directory,
+                    self.rank if self.rank is not None else "unknown",
+                    os.getpid())
+                self._f = open(self.path, "a")
+            except OSError as exc:
+                self.broken = str(exc)
+        return self._f
+
+    def _emit(self, record: Dict[str, Any]) -> None:
+        with self._io_lock:
+            f = self._open()
+            if f is None:
+                return
+            try:
+                f.write(json.dumps(record) + "\n")
+                # One flush per chunk: the written bytes become
+                # kernel-owned, surviving SIGKILL of this process.
+                f.flush()
+            except (OSError, ValueError, TypeError) as exc:
+                self.broken = str(exc)
+
+    def write_chunk(self, events: List[Dict[str, Any]]) -> None:
+        """Append one chunk line. Called by the tracer with the batch it
+        just detached from its resident buffer (so file I/O here never
+        grows tracer memory)."""
+        self.first_t = None
+        if not events or self.broken is not None:
+            return
+        from ..utils import trace
+
+        self._emit({"v": SPOOL_VERSION, "t": "chunk",
+                    "rank": self.rank, "pid": os.getpid(),
+                    "seq": self.seq, "anchor_ns": trace.wall_anchor_ns(),
+                    "events": events})
+        self.seq += 1
+        self.chunks_written += 1
+        self.events_written += len(events)
+
+    def write_footer(self) -> None:
+        """Finalize record: counters and collective entries, so a bundle
+        reconstructed from the spool alone carries the same fields as a
+        live-gathered one. Written once."""
+        if self.footer_written or self.broken is not None:
+            return
+        self.footer_written = True
+        from ..utils import trace
+        from . import flight, metrics
+
+        self._emit({"v": SPOOL_VERSION, "t": "footer",
+                    "rank": self.rank, "pid": os.getpid(),
+                    "counters": trace.counters(),
+                    "dropped": trace.dropped(),
+                    "collective_entries": metrics.collective_entries(),
+                    "op_counts": flight.snapshot()["op_counts"]})
+
+    def read_back_events(self) -> List[Dict[str, Any]]:
+        """This rank's already-flushed spans, in flush order (the
+        Finalize gather prepends them to the resident tail so the merged
+        trace stays complete under streaming)."""
+        if self.path is None:
+            return []
+        b = parse_spool(self.path)
+        return b["events"] if b else []
+
+    def close(self) -> None:
+        with self._io_lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+
+def parse_spool(path: str) -> Optional[Dict[str, Any]]:
+    """Rebuild a :func:`mpi_tpu.observe.collect.local_bundle`-shaped
+    dict from one spool file. Tolerant: a truncated trailing line
+    (death mid-write) and unknown record types are skipped. Returns
+    None when the file is unreadable or holds no parseable record."""
+    bundle: Dict[str, Any] = {
+        "rank": None, "pid": None, "anchor_ns": 0,
+        "events": [], "counters": {}, "dropped": 0,
+        "collective_entries": [],
+        "flight": {"op_counts": {}},
+        "spool": path, "spool_chunks": 0,
+    }
+    got_any = False
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # truncated tail / torn write
+                if not isinstance(rec, dict):
+                    continue
+                kind = rec.get("t")
+                if kind == "chunk":
+                    got_any = True
+                    bundle["rank"] = rec.get("rank", bundle["rank"])
+                    bundle["pid"] = rec.get("pid", bundle["pid"])
+                    bundle["anchor_ns"] = rec.get("anchor_ns",
+                                                  bundle["anchor_ns"])
+                    bundle["events"].extend(rec.get("events", []))
+                    bundle["spool_chunks"] += 1
+                elif kind == "footer":
+                    got_any = True
+                    bundle["rank"] = rec.get("rank", bundle["rank"])
+                    bundle["pid"] = rec.get("pid", bundle["pid"])
+                    bundle["counters"] = rec.get("counters", {})
+                    bundle["dropped"] = rec.get("dropped", 0)
+                    bundle["collective_entries"] = rec.get(
+                        "collective_entries", [])
+                    bundle["flight"] = {
+                        "op_counts": rec.get("op_counts", {})}
+    except OSError:
+        return None
+    return bundle if got_any else None
+
+
+def scan_spools(directory: str) -> Dict[int, Dict[str, Any]]:
+    """All reconstructable bundles in a spool directory, keyed by rank.
+    When one rank left several spool files (restarts), the most recently
+    modified wins. Files whose rank never resolved are skipped — an
+    unattributable track would corrupt the merge."""
+    found: Dict[int, Dict[str, Any]] = {}
+    mtimes: Dict[int, float] = {}
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "spool-rank*.ndjson"))):
+        b = parse_spool(path)
+        if b is None or not isinstance(b.get("rank"), int):
+            continue
+        try:
+            mt = os.path.getmtime(path)
+        except OSError:
+            mt = 0.0
+        r = b["rank"]
+        if r not in found or mt >= mtimes[r]:
+            found[r] = b
+            mtimes[r] = mt
+    return found
+
+
+def reconstruct_bundles(directory: str,
+                        ranks: Optional[List[int]] = None
+                        ) -> Dict[int, Dict[str, Any]]:
+    """Bundles for the given ranks (all spooled ranks when None) — the
+    ``mpirun`` post-job path: dead ranks' evidence without any
+    surviving process's cooperation."""
+    found = scan_spools(directory)
+    if ranks is None:
+        return found
+    return {r: found[r] for r in ranks if r in found}

@@ -24,7 +24,14 @@ def force_platform(name: str, num_cpu_devices: Optional[int] = None) -> bool:
 
     try:
         if num_cpu_devices is not None:
-            jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+            try:
+                jax.config.update("jax_num_cpu_devices",
+                                  num_cpu_devices)
+            except AttributeError:
+                # Older jax has no virtual-CPU-count option; the
+                # platform pin below still applies and callers that
+                # oversubscribe rank threads work on 1 device.
+                pass
         jax.config.update("jax_platforms", name)
     except RuntimeError:
         return False

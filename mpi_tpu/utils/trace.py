@@ -41,6 +41,10 @@ __all__ = [
     "clear",
     "dump_chrome_trace",
     "wall_anchor_ns",
+    "add_span",
+    "set_stream",
+    "stream",
+    "flush_stream",
     "profile",
 ]
 
@@ -59,13 +63,31 @@ class _Tracer:
         # wall_ns ≈ ts_ns + anchor — which is what the job-wide merge
         # (mpi_tpu.observe.collect) aligns across ranks.
         self.wall_anchor_ns = time.time_ns() - time.perf_counter_ns()
+        # Optional streaming sink (mpi_tpu.observe.stream.SpoolWriter).
+        # When set, the resident buffer is bounded by the sink's chunk
+        # watermarks instead of _MAX_EVENTS: full batches are detached
+        # and handed to the sink, keeping memory O(chunk) over any job
+        # length and making flushed spans crash-durable.
+        self.stream: Optional[Any] = None
 
     def add_event(self, ev: Dict[str, Any]) -> None:
         with self.lock:
-            if len(self.events) >= _MAX_EVENTS:
-                self.dropped += 1
+            st = self.stream
+            if st is None:
+                if len(self.events) >= _MAX_EVENTS:
+                    self.dropped += 1
+                    return
+                self.events.append(ev)
                 return
             self.events.append(ev)
+            now = time.monotonic()
+            if st.first_t is None:
+                st.first_t = now
+            if (len(self.events) >= st.max_events
+                    or now - st.first_t >= st.max_age_s):
+                batch = self.events
+                self.events = []
+                st.write_chunk(batch)
 
     def add_count(self, name: str, value: float) -> None:
         with self.lock:
@@ -108,6 +130,51 @@ def span(name: str, **attrs: Any) -> Iterator[None]:
         })
 
 
+def add_span(name: str, ts_us: float, dur_us: float, **attrs: Any) -> None:
+    """Record a completed span with explicit perf_counter timestamps
+    (µs). For sub-op stages measured outside Python's control flow —
+    e.g. the native wirecore stage scratch read back after the call —
+    where a ``with span(...)`` block cannot bracket the work."""
+    if not _tracer.enabled:
+        return
+    _tracer.add_event({
+        "name": name,
+        "ts_us": ts_us,
+        "dur_us": dur_us,
+        "thread": threading.current_thread().name,
+        **attrs,
+    })
+
+
+def set_stream(writer: Optional[Any]) -> None:
+    """Install (or remove, with None) a streaming sink — an object with
+    ``max_events`` / ``max_age_s`` / ``first_t`` attributes and a
+    ``write_chunk(events)`` method (see
+    :class:`mpi_tpu.observe.stream.SpoolWriter`). While installed, full
+    event batches are flushed to it instead of accumulating."""
+    with _tracer.lock:
+        _tracer.stream = writer
+
+
+def stream() -> Optional[Any]:
+    """The installed streaming sink, or None."""
+    return _tracer.stream
+
+
+def flush_stream() -> int:
+    """Force the resident tail out to the streaming sink (finalize /
+    fatal-error path). Returns the number of events flushed; no-op
+    without a sink."""
+    with _tracer.lock:
+        st = _tracer.stream
+        if st is None:
+            return 0
+        batch = _tracer.events
+        _tracer.events = []
+        st.write_chunk(batch)
+        return len(batch)
+
+
 def count(name: str, value: float = 1) -> None:
     """Accumulate a counter (e.g. ``comm.send.bytes``). No-op when
     disabled."""
@@ -143,6 +210,8 @@ def clear() -> None:
         _tracer.events.clear()
         _tracer.counters.clear()
         _tracer.dropped = 0
+        if _tracer.stream is not None:
+            _tracer.stream.first_t = None
 
 
 def dump_chrome_trace(path: str) -> int:

@@ -24,10 +24,28 @@
 // touch Python state. Little-endian hosts only — the loader enforces
 // sys.byteorder == "little" (the memcpy'd tag/length below are raw host
 // order).
+//
+// Stage scratch (v4): every entry point takes a nullable uint64_t
+// *stages — a caller-owned, caller-zeroed scratch array that the call
+// ACCUMULATES per-stage nanoseconds and counts into, so the tracer can
+// name where a frame's microseconds went without any locking (the
+// scratch is private to one in-flight call; an -EINTR resume keeps
+// accumulating into the same array). Layout:
+//   send (wc_send_frame / wc_send_frame2):
+//     stages[0] += ns assembling the header        (encode stage)
+//     stages[1] += ns inside writev                (syscall stage)
+//     stages[2] += writev invocations
+//     stages[3] += bytes accepted by the kernel
+//   recv (wc_recv_exact):
+//     stages[0] += ns inside recv
+//     stages[1] += recv invocations
+//     stages[2] += bytes received
+// Pass nullptr to skip all clock reads (the untraced hot path).
 
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 
 #include <sys/socket.h>
 #include <sys/uio.h>
@@ -38,6 +56,13 @@ namespace {
 constexpr int kPeerClosed = 1000;
 constexpr uint64_t kHeaderLen = 13;
 
+inline uint64_t now_ns() {
+  timespec ts;
+  ::clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+         static_cast<uint64_t>(ts.tv_nsec);
+}
+
 }  // namespace
 
 extern "C" {
@@ -46,11 +71,13 @@ extern "C" {
 // *progress counts total frame bytes already written (header included);
 // start with 0 and re-invoke unchanged after -EINTR.
 int wc_send_frame(int fd, uint8_t kind, int64_t tag, const uint8_t *payload,
-                  uint32_t length, uint64_t *progress) {
+                  uint32_t length, uint64_t *progress, uint64_t *stages) {
+  const uint64_t t_asm = stages ? now_ns() : 0;
   uint8_t header[kHeaderLen];
   header[0] = kind;
   std::memcpy(header + 1, &tag, 8);
   std::memcpy(header + 9, &length, 4);
+  if (stages) stages[0] += now_ns() - t_asm;
   const uint64_t total = kHeaderLen + length;
   while (*progress < total) {
     uint64_t done = *progress;
@@ -69,8 +96,14 @@ int wc_send_frame(int fd, uint8_t kind, int64_t tag, const uint8_t *payload,
       iov[iovcnt].iov_len = length - done;
       ++iovcnt;
     }
+    const uint64_t t_io = stages ? now_ns() : 0;
     ssize_t n = ::writev(fd, iov, iovcnt);
+    if (stages) {
+      stages[1] += now_ns() - t_io;
+      stages[2] += 1;
+    }
     if (n < 0) return -errno;  // -EINTR resumes from *progress
+    if (stages) stages[3] += static_cast<uint64_t>(n);
     *progress += static_cast<uint64_t>(n);
   }
   return 0;
@@ -85,7 +118,8 @@ int wc_send_frame(int fd, uint8_t kind, int64_t tag, const uint8_t *payload,
 int wc_send_frame2(int fd, uint8_t kind, int64_t tag,
                    const uint8_t *prefix, uint32_t prefix_len,
                    const uint8_t *payload, uint32_t payload_len,
-                   uint64_t *progress) {
+                   uint64_t *progress, uint64_t *stages) {
+  const uint64_t t_asm = stages ? now_ns() : 0;
   const uint64_t length64 =
       static_cast<uint64_t>(prefix_len) + payload_len;
   if (length64 > 0xFFFFFFFFull) return -EMSGSIZE;
@@ -94,6 +128,7 @@ int wc_send_frame2(int fd, uint8_t kind, int64_t tag,
   header[0] = kind;
   std::memcpy(header + 1, &tag, 8);
   std::memcpy(header + 9, &length, 4);
+  if (stages) stages[0] += now_ns() - t_asm;
   const uint64_t total = kHeaderLen + length64;
   while (*progress < total) {
     uint64_t done = *progress;
@@ -120,8 +155,14 @@ int wc_send_frame2(int fd, uint8_t kind, int64_t tag,
       iov[iovcnt].iov_len = payload_len - done;
       ++iovcnt;
     }
+    const uint64_t t_io = stages ? now_ns() : 0;
     ssize_t n = ::writev(fd, iov, iovcnt);
+    if (stages) {
+      stages[1] += now_ns() - t_io;
+      stages[2] += 1;
+    }
     if (n < 0) return -errno;  // -EINTR resumes from *progress
+    if (stages) stages[3] += static_cast<uint64_t>(n);
     *progress += static_cast<uint64_t>(n);
   }
   return 0;
@@ -129,17 +170,24 @@ int wc_send_frame2(int fd, uint8_t kind, int64_t tag,
 
 // Receive exactly n bytes into buf. *progress counts bytes already read;
 // start with 0 and re-invoke unchanged after -EINTR.
-int wc_recv_exact(int fd, uint8_t *buf, uint64_t n, uint64_t *progress) {
+int wc_recv_exact(int fd, uint8_t *buf, uint64_t n, uint64_t *progress,
+                  uint64_t *stages) {
   while (*progress < n) {
+    const uint64_t t_io = stages ? now_ns() : 0;
     ssize_t r = ::recv(fd, buf + *progress, n - *progress, 0);
+    if (stages) {
+      stages[0] += now_ns() - t_io;
+      stages[1] += 1;
+    }
     if (r < 0) return -errno;  // -EINTR resumes from *progress
     if (r == 0) return kPeerClosed;
+    if (stages) stages[2] += static_cast<uint64_t>(r);
     *progress += static_cast<uint64_t>(r);
   }
   return 0;
 }
 
 // Sanity probe for the loader.
-int wc_version() { return 3; }
+int wc_version() { return 4; }
 
 }  // extern "C"

@@ -38,6 +38,13 @@ class TestBuildCommands:
         assert cmd[cmd.index("--mpi-inittimeout") + 1] == "10s"
         assert cmd[cmd.index("--mpi-password") + 1] == "pw"
 
+    def test_trace_stream_injection(self):
+        cmds = mpirun.build_commands(2, "p", [], trace_stream="/tmp/spools")
+        for cmd in cmds:
+            assert cmd[cmd.index("--mpi-trace-stream") + 1] == "/tmp/spools"
+        # Absent by default — the spool path must be opt-in.
+        assert "--mpi-trace-stream" not in mpirun.build_commands(1, "p", [])[0]
+
 
 def _run_cli(args, timeout=90):
     return subprocess.run(

@@ -30,6 +30,7 @@ import pytest
 import mpi_tpu
 from mpi_tpu import collectives_generic as G
 from mpi_tpu.observe import collect, flight, metrics
+from mpi_tpu.observe import stream as spool
 from mpi_tpu.utils import trace
 
 from conftest import _free_port_block, run_on_ranks, tcp_cluster
@@ -526,6 +527,399 @@ class TestDisabledOverhead:
                 pass
         per_us = (time.perf_counter() - t0) / n * 1e6
         assert per_us < 10.0, per_us
+
+
+# ---------------------------------------------------------------------------
+# Streaming trace spooling (ISSUE 15 tentpole)
+# ---------------------------------------------------------------------------
+
+
+class TestStreamingSpool:
+    def test_chunk_roundtrip_and_scan(self, tmp_path, monkeypatch):
+        """Spooled chunks + footer read back into one bundle; scan_spools
+        keys it by rank."""
+        monkeypatch.setenv("MPI_TPU_TRACE_STREAM_EVENTS", "4")
+        w = spool.SpoolWriter(str(tmp_path), rank=3)
+        w.write_chunk([{"name": f"op{i}", "ts_us": float(i),
+                        "dur_us": 1.0} for i in range(4)])
+        w.write_chunk([{"name": "tail", "ts_us": 9.0, "dur_us": 1.0}])
+        w.write_footer()
+        w.close()
+        assert w.chunks_written == 2 and w.events_written == 5
+        b = spool.parse_spool(w.path)
+        assert b is not None and b["rank"] == 3
+        assert len(b["events"]) == 5 and b["spool_chunks"] == 2
+        assert b["events"][0]["name"] == "op0"
+        assert b["events"][-1]["name"] == "tail"
+        found = spool.scan_spools(str(tmp_path))
+        assert set(found) == {3}
+        assert len(found[3]["events"]) == 5
+
+    def test_torn_trailing_line_tolerated(self, tmp_path):
+        """Death mid-write leaves a truncated last line; everything
+        before it must still parse (the crash-durability contract)."""
+        w = spool.SpoolWriter(str(tmp_path), rank=1)
+        w.write_chunk([{"name": "a", "ts_us": 0.0, "dur_us": 1.0}])
+        w.write_chunk([{"name": "b", "ts_us": 1.0, "dur_us": 1.0}])
+        w.close()
+        raw = Path(w.path).read_text()
+        lines = raw.splitlines(keepends=True)
+        Path(w.path).write_text(lines[0] + lines[1][: len(lines[1]) // 2])
+        b = spool.parse_spool(w.path)
+        assert b is not None
+        assert [e["name"] for e in b["events"]] == ["a"]
+
+    def test_tracer_streams_at_watermark(self, tmp_path, monkeypatch):
+        """The tracer's resident buffer stays O(chunk): batches detach
+        to the spool at the size watermark, and flush_stream pushes the
+        sub-chunk tail."""
+        monkeypatch.setenv("MPI_TPU_TRACE_STREAM_EVENTS", "4")
+        trace.enable()
+        w = spool.SpoolWriter(str(tmp_path), rank=0)
+        trace.set_stream(w)
+        for i in range(10):
+            trace.add_span(f"s{i}", float(i), 1.0)
+        assert w.chunks_written == 2          # 2 full chunks of 4
+        assert len(trace.events()) == 2       # resident tail only
+        assert trace.flush_stream() == 2
+        assert trace.events() == []
+        assert w.events_written == 10
+        b = spool.parse_spool(w.path)
+        assert len(b["events"]) == 10
+
+    def test_age_watermark_flushes_stale_tail(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MPI_TPU_TRACE_STREAM_EVENTS", "1000")
+        monkeypatch.setenv("MPI_TPU_TRACE_STREAM_AGE_S", "0.05")
+        trace.enable()
+        w = spool.SpoolWriter(str(tmp_path), rank=0)
+        trace.set_stream(w)
+        trace.add_span("old", 0.0, 1.0)
+        assert w.chunks_written == 0
+        time.sleep(0.1)
+        trace.add_span("young", 1.0, 1.0)   # arrival check fires the age
+        assert w.chunks_written == 1
+        assert trace.events() == []
+
+    def test_broken_writer_goes_silent(self, tmp_path):
+        """Spool I/O failure must never take the job down: the writer
+        records the error and becomes a no-op."""
+        target = tmp_path / "not-a-dir"
+        target.write_text("file, not a directory")
+        w = spool.SpoolWriter(str(target), rank=0)
+        w.write_chunk([{"name": "x", "ts_us": 0.0, "dur_us": 1.0}])
+        assert w.broken is not None
+        w.write_chunk([{"name": "y", "ts_us": 1.0, "dur_us": 1.0}])
+        w.write_footer()  # still silent
+        w.close()
+
+    def test_streaming_overhead_smoke(self, tmp_path, monkeypatch):
+        """Satellite: streaming on must stay within the same per-event
+        budget as the plain tracer — the flush is amortized over the
+        chunk, so the hot path adds an attribute check and a batch
+        handoff every N events."""
+        n = 4000
+        trace.enable()
+        t0 = time.perf_counter()
+        for i in range(n):
+            trace.add_span("plain", float(i), 1.0)
+        plain_us = (time.perf_counter() - t0) / n * 1e6
+        trace.clear()
+        monkeypatch.setenv("MPI_TPU_TRACE_STREAM_EVENTS", "512")
+        w = spool.SpoolWriter(str(tmp_path), rank=0)
+        trace.set_stream(w)
+        t0 = time.perf_counter()
+        for i in range(n):
+            trace.add_span("streamed", float(i), 1.0)
+        streamed_us = (time.perf_counter() - t0) / n * 1e6
+        assert w.chunks_written >= n // 512
+        # Generous absolute bounds (CI boxes vary); the point is that
+        # neither path costs tens of microseconds per span.
+        assert plain_us < 50.0, plain_us
+        assert streamed_us < 50.0, streamed_us
+
+    def test_local_bundle_includes_spooled_events(self, tmp_path,
+                                                  monkeypatch):
+        """The Finalize gather must stay complete under streaming:
+        already-flushed chunks are read back and prepended to the
+        resident tail."""
+        monkeypatch.setenv("MPI_TPU_TRACE_STREAM_EVENTS", "2")
+        trace.enable()
+        w = spool.SpoolWriter(str(tmp_path), rank=0)
+        trace.set_stream(w)
+        for i in range(5):
+            trace.add_span(f"s{i}", float(i), 1.0)
+        b = collect.local_bundle(0)
+        assert [e["name"] for e in b["events"]] == [
+            f"s{i}" for i in range(5)]
+        assert b["spool"] == w.path and b["spool_chunks"] == 2
+
+    def test_gather_recovers_missing_rank_from_spool(self, tmp_path,
+                                                     monkeypatch):
+        """Rank 0's gather reconstructs a dead rank's track from its
+        spool file; the rank stays listed as missing (it IS dead) and
+        is flagged as spool-reconstructed."""
+        monkeypatch.setenv("MPI_TPU_TRACE_STREAM", str(tmp_path))
+        import mpi_tpu.observe as observe
+
+        observe.reset_for_testing()  # re-resolve config with the env
+        dead = spool.SpoolWriter(str(tmp_path), rank=1)
+        dead.write_chunk([{"name": "dead.work", "ts_us": 5.0,
+                           "dur_us": 2.0}])
+        dead.close()
+        bundles = {0: collect.local_bundle(0)}
+        offsets = {0: {"offset_ns": 0.0, "rtt_ns": 0.0}}
+        missing = [1]
+        recovered = collect._recover_from_spools(bundles, offsets, missing)
+        assert recovered == [1]
+        assert 1 in bundles and bundles[1]["events"][0]["name"] == \
+            "dead.work"
+        assert missing == [1]  # stays dead
+
+    def test_footer_written_once(self, tmp_path):
+        w = spool.SpoolWriter(str(tmp_path), rank=0)
+        w.write_chunk([{"name": "x", "ts_us": 0.0, "dur_us": 1.0}])
+        w.write_footer()
+        w.write_footer()
+        w.close()
+        lines = Path(w.path).read_text().splitlines()
+        assert sum(1 for ln in lines
+                   if json.loads(ln)["t"] == "footer") == 1
+
+
+# ---------------------------------------------------------------------------
+# Native wirecore stage spans (ISSUE 15 tentpole)
+# ---------------------------------------------------------------------------
+
+
+class TestNativeStageSpans:
+    def test_stage_child_spans_on_tcp_path(self):
+        """Acceptance: with tracing on, the native TCP data path emits
+        wire.write.assemble / wire.write.syscall / wire.recv.syscall
+        child spans and the wire.native.* counters."""
+        from mpi_tpu import native as native_mod
+
+        if not native_mod.available("wirecore"):
+            pytest.skip("native wirecore unavailable here")
+        trace.enable()
+        with tcp_cluster(2) as nets:
+            def fn(net, r):
+                if r == 0:
+                    net.send(np.zeros(16384, np.float32), 1, 3)
+                else:
+                    net.receive(0, 3)
+
+            run_on_ranks(nets, fn, timeout=30)
+        evs = trace.events()
+        names = {e["name"] for e in evs}
+        assert "wire.write.assemble" in names
+        assert "wire.write.syscall" in names
+        assert "wire.recv.syscall" in names
+        counters = trace.counters()
+        assert counters.get("wire.native.tx.writev_calls", 0) >= 1
+        assert counters.get("wire.native.rx.recv_calls", 0) >= 1
+        assert counters.get("wire.native.tx.syscall_ns", 0) > 0
+        # Child spans start no earlier than their wire.write parent and
+        # the syscall child carries the byte count.
+        writes = [e for e in evs if e["name"] == "wire.write"]
+        for c in (e for e in evs if e["name"] == "wire.write.syscall"):
+            assert any(w["ts_us"] <= c["ts_us"] + 1.0 for w in writes), c
+            assert c["bytes"] > 0 and c["writev_calls"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Decode-phase deadline (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestDecodeDeadline:
+    def test_slow_decode_trips_optimeout(self, monkeypatch):
+        """--mpi-optimeout now covers the decode phase: a payload that
+        arrives in time but decodes past the deadline raises
+        DeadlineError instead of returning arbitrarily late."""
+        from mpi_tpu.backends import tcp as tcpmod
+
+        real = tcpmod.codec_decode
+
+        def slow(payload, out=None):
+            time.sleep(0.6)
+            return real(payload, out=out)
+
+        with tcp_cluster(2, optimeout=0.2) as nets:
+            monkeypatch.setattr(tcpmod, "codec_decode", slow)
+
+            def fn(net, r):
+                if r == 0:
+                    net.send(b"x" * 64, 1, 7)
+                else:
+                    with pytest.raises(tcpmod.DeadlineError) as ei:
+                        net.receive(0, 7)
+                    assert "decode" in str(ei.value)
+
+            run_on_ranks(nets, fn, timeout=30)
+
+    def test_fast_decode_unaffected(self):
+        with tcp_cluster(2, optimeout=5.0) as nets:
+            def fn(net, r):
+                if r == 0:
+                    net.send(b"y" * 64, 1, 8)
+                else:
+                    assert bytes(net.receive(0, 8)) == b"y" * 64
+
+            run_on_ranks(nets, fn, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Bench regression gate (ISSUE 15 tentpole)
+# ---------------------------------------------------------------------------
+
+
+class TestBenchGate:
+    GATE = str(REPO / "tools" / "bench_gate.py")
+
+    def _run(self, *args):
+        return subprocess.run([sys.executable, self.GATE, *args],
+                              capture_output=True, text=True, timeout=60)
+
+    def _write(self, tmp_path, name, rec):
+        p = tmp_path / name
+        p.write_text(json.dumps(rec))
+        return str(p)
+
+    def test_exit_codes(self, tmp_path):
+        base = self._write(tmp_path, "base.json", {
+            "platform": "cpu", "smoke": False,
+            "allreduce_8MiB_p50_us": 10000.0, "bounce_p50_us": 5000.0})
+        ok = self._write(tmp_path, "ok.json", {
+            "platform": "cpu", "smoke": False,
+            "allreduce_8MiB_p50_us": 10400.0, "bounce_p50_us": 5100.0})
+        bad = self._write(tmp_path, "bad.json", {
+            "platform": "cpu", "smoke": False,
+            "allreduce_8MiB_p50_us": 25000.0, "bounce_p50_us": 5100.0})
+        assert self._run(base, ok).returncode == 0
+        res = self._run(base, bad)
+        assert res.returncode == 1
+        assert "REGRESSION allreduce_8MiB_p50_us" in res.stdout
+        assert self._run(base, bad, "--warn-only").returncode == 0
+        # Allowlist: a regression outside --keys reports but passes.
+        assert self._run(base, bad, "--keys",
+                         "bounce_p50_us").returncode == 0
+        # Threshold override loosens the verdict.
+        assert self._run(base, bad, "--pct", "200").returncode == 0
+        assert self._run(base, str(tmp_path / "nope.json")).returncode == 2
+
+    def test_incomparable_platforms_exit_2(self, tmp_path):
+        base = self._write(tmp_path, "b.json",
+                           {"platform": "cpu", "smoke": False,
+                            "x_p50_us": 10000.0})
+        cur = self._write(tmp_path, "c.json",
+                          {"platform": "tpu", "smoke": False,
+                           "x_p50_us": 10000.0})
+        res = self._run(base, cur)
+        assert res.returncode == 2
+        assert "incomparable" in res.stderr
+
+    def test_metrics_artifacts_flattened(self, tmp_path):
+        mk = lambda p50: {"schema_version": 1, "rank": 0,
+                          "ops": {"send": {"count": 10, "p50_us": p50,
+                                           "p99_us": p50 * 2}}}
+        base = self._write(tmp_path, "mb.json", mk(8000.0))
+        cur = self._write(tmp_path, "mc.json", mk(20000.0))
+        res = self._run(base, cur)
+        assert res.returncode == 1
+        assert "op_send_p50_us" in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# Crash-durable spooling under real mpirun (integration)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.integration
+class TestCrashDurableSpooling:
+    def test_sigkill_mid_bounce_reconstructs_trace(self, tmp_path):
+        """Acceptance: a rank SIGKILLed mid-bounce (no atexit, no
+        finalize, no flight dump) still appears in the merged trace with
+        its last flushed spans, reconstructed from its spool file; its
+        tail is folded into the job postmortem."""
+        prog = tmp_path / "bounce_kill.py"
+        prog.write_text(
+            "import os, signal, sys\n"
+            "sys.path.insert(0, %r)\n"
+            "import mpi_tpu\n"
+            "mpi_tpu.init()\n"
+            "r = mpi_tpu.rank()\n"
+            "for i in range(60):\n"
+            "    if r == 0:\n"
+            "        mpi_tpu.send(b'x' * 512, 1, i)\n"
+            "        mpi_tpu.receive(1, 1000 + i)\n"
+            "    else:\n"
+            "        mpi_tpu.receive(0, i)\n"
+            "        if i == 25:\n"
+            "            os.kill(os.getpid(), signal.SIGKILL)\n"
+            "        mpi_tpu.send(b'y' * 512, 0, 1000 + i)\n"
+            "mpi_tpu.finalize()\n" % str(REPO))
+        spools = tmp_path / "spools"
+        out = tmp_path / "merged.json"
+        port = _free_port_block(2)
+        res = _run_mpirun(
+            ["--port-base", str(port), "--timeout", "30",
+             "--optimeout", "10", "--trace-stream", str(spools),
+             "--trace-out", str(out), "2", str(prog)],
+            env={"MPI_TPU_TRACE_STREAM_EVENTS": "8"})
+        assert res.returncode != 0
+        # Both ranks spooled; the dead rank's file survives its SIGKILL.
+        assert list(spools.glob("spool-rank1-*.ndjson")), res.stderr
+        # The launcher reconstructed the merged trace from spools alone
+        # (the Finalize gather never ran — rank 0 died on peer loss).
+        doc = json.loads(out.read_text())
+        assert doc["metadata"]["source"] == "spool-reconstruction"
+        dead = [e for e in doc["traceEvents"]
+                if e.get("ph") == "X" and e["pid"] == 1]
+        assert dead, "dead rank's spooled spans missing from the trace"
+        names = {e["name"] for e in dead}
+        assert any(n.startswith(("mpi.", "wire.")) for n in names), names
+        # Spool tails folded into the job report, with the dead rank's
+        # final moments echoed despite the absent flight dump.
+        report = json.loads((spools / "job_postmortem.json").read_text())
+        assert report["spool_tails"]["1"]["last_spans"]
+        assert "no flight dump; last spooled span" in res.stderr
+
+    def test_chaos_crash_spool_survives(self, tmp_path):
+        """Chaos crash@K flushes the spool tail on its way down, so the
+        reconstructed trace carries the rank's pre-crash spans."""
+        prog = tmp_path / "chaos_bounce.py"
+        prog.write_text(
+            "import os, sys\n"
+            "sys.path.insert(0, %r)\n"
+            "os.environ['MPI_TPU_CHAOS'] = '3:1:crash@6'\n"
+            "import mpi_tpu\n"
+            "mpi_tpu.init()\n"
+            "r, n = mpi_tpu.rank(), mpi_tpu.size()\n"
+            "for step in range(100):\n"
+            "    mpi_tpu.sendrecv(r, dest=(r + 1) %% n,\n"
+            "                     source=(r - 1) %% n, tag=step)\n"
+            "sys.exit(0)\n" % str(REPO))
+        spools = tmp_path / "spools"
+        out = tmp_path / "merged.json"
+        pm = tmp_path / "pm"
+        port = _free_port_block(2)
+        res = _run_mpirun(
+            ["--port-base", str(port), "--timeout", "30",
+             "--postmortem-dir", str(pm), "--trace-stream", str(spools),
+             "--trace-out", str(out), "2", str(prog)],
+            env={"MPI_TPU_TRACE_STREAM_EVENTS": "8"})
+        assert res.returncode != 0
+        doc = json.loads(out.read_text())
+        assert doc["metadata"]["source"] == "spool-reconstruction"
+        crashed_pids = {e["pid"] for e in doc["traceEvents"]
+                        if e.get("ph") == "X"}
+        assert crashed_pids, "no spooled spans reconstructed"
+        report = json.loads((pm / "job_postmortem.json").read_text())
+        # Flight dumps (chaos crash runs them) AND spool tails coexist.
+        assert report["ranks"]
+        assert report["spool_tails"]
+        for r in report["spool_tails"].values():
+            assert r["events_spooled"] > 0
 
 
 # ---------------------------------------------------------------------------

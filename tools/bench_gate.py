@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""bench_gate — diff two bench artifacts and fail CI on regression.
+
+Compares a CURRENT bench artifact against a BASE artifact using the
+same directional, materiality-floored check the bench itself runs
+against the committed ``BENCH_FULL.json`` (``bench._regression_check``
+— one classifier, no drift between local and CI verdicts).
+
+Artifacts accepted:
+
+* ``BENCH_FULL.json`` — the bench's own full-result dict (flat keys).
+* ``--mpi-metrics-out`` per-rank artifacts — recognised by their
+  ``schema_version``/``ops`` shape and flattened into comparable
+  numeric keys (``op_<name>_p50_us`` etc.) before the check runs.
+
+Exit codes: 0 ok (or ``--warn-only``), 1 regression(s) found,
+2 artifact unreadable/incomparable.
+
+Usage::
+
+    python tools/bench_gate.py BASE.json CURRENT.json \
+        [--pct 30] [--keys k1,k2,...] [--warn-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError) as exc:
+        print(f"bench_gate: cannot read {path}: {exc}", file=sys.stderr)
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def _flatten_metrics(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """A ``--mpi-metrics-out`` artifact flattened to bench-style keys;
+    any other dict passes through unchanged."""
+    if "schema_version" not in rec or "ops" not in rec:
+        return rec
+    flat: Dict[str, Any] = {
+        # _regression_check's like-for-like gate needs these present
+        # and equal on both sides; metrics artifacts are always
+        # self-comparable.
+        "platform": rec.get("platform", "metrics"),
+        "smoke": False,
+    }
+    for op, stats in (rec.get("ops") or {}).items():
+        if not isinstance(stats, dict):
+            continue
+        for stat, val in stats.items():
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                suffix = stat if stat.endswith(("_us", "_ms")) \
+                    else f"{stat}_count" if stat == "count" else stat
+                flat[f"op_{op}_{suffix}"] = val
+    for k, v in rec.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and k not in flat:
+            flat[k] = v
+    return flat
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_gate",
+        description="fail when CURRENT regresses vs BASE (bench's own "
+                    "directional check)")
+    ap.add_argument("base", help="baseline artifact (previous round)")
+    ap.add_argument("current", help="artifact under test")
+    ap.add_argument("--pct", type=float, default=None,
+                    help="regression threshold percent "
+                         "(default: MPI_TPU_BENCH_REGRESS_PCT or 30)")
+    ap.add_argument("--keys", default=None,
+                    help="comma-separated key allowlist: only these "
+                         "keys can gate (others still reported)")
+    ap.add_argument("--warn-only", action="store_true",
+                    help="report regressions but exit 0 (bootstrap "
+                         "rounds / noisy boxes)")
+    args = ap.parse_args(argv)
+
+    if args.pct is not None:
+        os.environ["MPI_TPU_BENCH_REGRESS_PCT"] = str(args.pct)
+
+    sys.path.insert(0, _REPO)
+    import bench  # noqa: E402  — top-level bench imports are light
+
+    base = _load(args.base)
+    cur = _load(args.current)
+    if base is None or cur is None:
+        return 2
+    base = _flatten_metrics(base)
+    cur = _flatten_metrics(cur)
+
+    # _regression_check mutates its `full` arg; gate on a copy so the
+    # caller's artifact file semantics stay read-only.
+    full = dict(cur)
+    bench._regression_check(full, base)
+    if "regressions" not in full:
+        print(f"bench_gate: {full.get('regressions_vs', 'incomparable')}",
+              file=sys.stderr)
+        return 2
+
+    regs = full["regressions"]
+    if args.keys:
+        allow = {k.strip() for k in args.keys.split(",") if k.strip()}
+        gating = [r for r in regs if r["key"] in allow]
+        ignored = [r for r in regs if r["key"] not in allow]
+    else:
+        gating, ignored = regs, []
+
+    for r in gating:
+        print(f"REGRESSION {r['key']}: {r['prev']} -> {r['now']} "
+              f"({r['ratio']}x)")
+    for r in ignored:
+        print(f"regressed (not gated) {r['key']}: {r['prev']} -> "
+              f"{r['now']} ({r['ratio']}x)")
+    for r in full.get("regressions_suppressed", []):
+        print(f"suppressed {r['key']}: {r['prev']} -> {r['now']} "
+              f"({r['reason']})")
+    if not regs:
+        print("bench_gate: no regressions "
+              f"({args.current} vs {args.base})")
+    if gating and not args.warn_only:
+        return 1
+    if gating:
+        print(f"bench_gate: --warn-only, not failing "
+              f"({len(gating)} regression(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
